@@ -1,0 +1,18 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh — the JAX
+analog of the reference's localhost fake-cluster trick
+(mkl-scripts/submit_mac_dist.sh: 1 ps + 2 workers on localhost ports), per
+SURVEY.md §4."""
+
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,56 @@
+import json
+
+import pytest
+
+from tpu_resnet.config import PRESETS, RunConfig, load_config
+
+
+def test_default_roundtrip():
+    cfg = RunConfig()
+    d = json.loads(cfg.to_json())
+    cfg2 = RunConfig.from_dict(d)
+    assert cfg2.to_dict() == cfg.to_dict()
+
+
+def test_presets_build():
+    for name in PRESETS:
+        cfg = load_config(name)
+        assert cfg.data.num_classes > 0
+
+
+def test_cifar_preset_matches_reference_recipe():
+    # README.md:28 local config: batch 128, piecewise LR, wd 2e-4.
+    cfg = load_config("cifar10")
+    assert cfg.train.global_batch_size == 128
+    assert cfg.optim.schedule == "cifar_piecewise"
+    assert cfg.optim.weight_decay == pytest.approx(2e-4)
+
+
+def test_imagenet_preset_matches_intel_caffe_recipe():
+    # resnet_imagenet_train.py:236-260 + submit_imagenet_daint_dist.sh:38-40.
+    cfg = load_config("imagenet")
+    assert cfg.train.global_batch_size == 1024
+    assert cfg.train.train_steps == 112_600
+    assert cfg.optim.weight_decay == pytest.approx(1e-4)
+    assert cfg.optim.warmup_steps == 6240
+
+
+def test_overrides():
+    cfg = load_config("smoke", overrides=[
+        "train.train_steps=7", "model.compute_dtype=bfloat16",
+        "data.use_native_loader=false"])
+    assert cfg.train.train_steps == 7
+    assert cfg.model.compute_dtype == "bfloat16"
+    assert cfg.data.use_native_loader is False
+
+
+def test_bad_override_rejected():
+    with pytest.raises(ValueError):
+        load_config("smoke", overrides=["train.nope=1"])
+    with pytest.raises(ValueError):
+        load_config("smoke", overrides=["no_equals"])
+
+
+def test_unknown_preset():
+    with pytest.raises(ValueError):
+        load_config("nope")

@@ -1,0 +1,178 @@
+"""Data-layer tests: CIFAR binary parsing against hand-built fixtures
+(format per reference cifar_input.py:39-68), sharded batching, augmentation
+semantics (cifar_input.py:70-79)."""
+
+import numpy as np
+import jax
+import pytest
+
+from tpu_resnet.data import augment, cifar, pipeline
+
+
+# ---------------------------------------------------------------- fixtures
+def write_cifar10_fixture(tmp_path, n_per_file=20):
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    all_images, all_labels = [], []
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        labels = rng.integers(0, 10, n_per_file, dtype=np.uint8)
+        images = rng.integers(0, 256, (n_per_file, 3, 32, 32), dtype=np.uint8)
+        records = np.concatenate(
+            [labels[:, None], images.reshape(n_per_file, -1)], axis=1)
+        (d / name).write_bytes(records.tobytes())
+        if name != "test_batch.bin":
+            all_images.append(images)
+            all_labels.append(labels)
+    return (np.concatenate(all_images).transpose(0, 2, 3, 1),
+            np.concatenate(all_labels).astype(np.int32))
+
+
+def test_cifar10_parse_roundtrip(tmp_path):
+    want_images, want_labels = write_cifar10_fixture(tmp_path)
+    images, labels = cifar.load_cifar("cifar10", str(tmp_path), train=True)
+    assert images.shape == (100, 32, 32, 3)
+    np.testing.assert_array_equal(images, want_images)
+    np.testing.assert_array_equal(labels, want_labels)
+
+
+def test_cifar100_fine_label_offset(tmp_path):
+    # cifar100 records: [coarse, fine, 3072 bytes]; reference reads the fine
+    # label via label_offset=1 (cifar_input.py:44-47).
+    d = tmp_path / "cifar-100-binary"
+    d.mkdir()
+    n = 10
+    rng = np.random.default_rng(1)
+    coarse = rng.integers(0, 20, n, dtype=np.uint8)
+    fine = rng.integers(0, 100, n, dtype=np.uint8)
+    images = rng.integers(0, 256, (n, 3072), dtype=np.uint8)
+    rec = np.concatenate([coarse[:, None], fine[:, None], images], axis=1)
+    (d / "train.bin").write_bytes(rec.tobytes())
+    (d / "test.bin").write_bytes(rec.tobytes())
+    _, labels = cifar.load_cifar("cifar100", str(tmp_path), train=True)
+    np.testing.assert_array_equal(labels, fine.astype(np.int32))
+
+
+def test_missing_files_raise(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        cifar.load_cifar("cifar10", str(tmp_path), train=True)
+
+
+def test_synthetic_deterministic():
+    a = cifar.synthetic_data(16, 32, 10, seed=3)
+    b = cifar.synthetic_data(16, 32, 10, seed=3)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+# ---------------------------------------------------------------- batching
+def test_sharded_batcher_epoch_coverage():
+    images = np.arange(40, dtype=np.uint8).reshape(40, 1, 1, 1)
+    labels = np.arange(40, dtype=np.int32)
+    b = pipeline.ShardedBatcher(images, labels, local_batch=8, seed=0,
+                                process_index=0, process_count=1)
+    seen = []
+    it = iter(b)
+    for _ in range(5):  # one epoch
+        _, lab = next(it)
+        seen.extend(lab.tolist())
+    assert sorted(seen) == list(range(40))
+
+
+def test_sharded_batcher_process_disjoint():
+    images = np.zeros((40, 1, 1, 1), np.uint8)
+    labels = np.arange(40, dtype=np.int32)
+    got = []
+    for pi in range(4):
+        b = pipeline.ShardedBatcher(images, labels, local_batch=10, seed=0,
+                                    shuffle=False, process_index=pi,
+                                    process_count=4)
+        _, lab = next(iter(b))
+        got.append(set(lab.tolist()))
+    # 4 processes own disjoint stripes covering all records
+    assert set.union(*got) == set(range(40))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not (got[i] & got[j])
+
+
+def test_batcher_deterministic_across_restarts():
+    images = np.zeros((64, 1, 1, 1), np.uint8)
+    labels = np.arange(64, dtype=np.int32)
+    runs = []
+    for _ in range(2):
+        b = iter(pipeline.ShardedBatcher(images, labels, 16, seed=7,
+                                         process_index=0, process_count=1))
+        runs.append([next(b)[1].tolist() for _ in range(8)])
+    assert runs[0] == runs[1]
+
+
+def test_batcher_start_step_fast_forward():
+    """Resume contract: a batcher started at step k yields exactly what an
+    uninterrupted run yields from its (k+1)-th batch on."""
+    images = np.zeros((64, 1, 1, 1), np.uint8)
+    labels = np.arange(64, dtype=np.int32)
+    full = iter(pipeline.ShardedBatcher(images, labels, 16, seed=7,
+                                        process_index=0, process_count=1))
+    stream = [next(full)[1].tolist() for _ in range(12)]
+    resumed = iter(pipeline.ShardedBatcher(images, labels, 16, seed=7,
+                                           process_index=0, process_count=1,
+                                           start_step=5))
+    resumed_stream = [next(resumed)[1].tolist() for _ in range(7)]
+    assert resumed_stream == stream[5:]
+
+
+def test_eval_batches_padding():
+    images = np.zeros((25, 2, 2, 3), np.uint8)
+    labels = np.arange(25, dtype=np.int32)
+    batches = list(pipeline.eval_batches(images, labels, 10))
+    assert len(batches) == 3
+    assert batches[-1][0].shape[0] == 10
+    assert (batches[-1][1][5:] == -1).all()  # padded slots marked invalid
+    total_valid = sum((lab >= 0).sum() for _, lab in batches)
+    assert total_valid == 25
+
+
+def test_background_iterator_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = pipeline.BackgroundIterator(gen())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError):
+        next(it)
+
+
+# -------------------------------------------------------------- augmentation
+def test_per_image_standardization_matches_tf_semantics():
+    rng = np.random.default_rng(0)
+    imgs = rng.uniform(0, 255, (4, 32, 32, 3)).astype(np.float32)
+    out = np.asarray(augment.per_image_standardization(imgs))
+    for i in range(4):
+        np.testing.assert_allclose(out[i].mean(), 0.0, atol=1e-4)
+        np.testing.assert_allclose(out[i].std(), 1.0, atol=1e-3)
+    # constant image: adjusted_stddev = 1/sqrt(N) floor, no NaN/Inf
+    const = np.full((1, 32, 32, 3), 7.0, np.float32)
+    out = np.asarray(augment.per_image_standardization(const))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0, atol=1e-5)
+
+
+def test_cifar_train_augment_shapes_and_determinism():
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (8, 32, 32, 3), dtype=np.uint8)
+    key = jax.random.PRNGKey(0)
+    a = np.asarray(augment.cifar_train_augment(key, imgs))
+    b = np.asarray(augment.cifar_train_augment(key, imgs))
+    assert a.shape == (8, 32, 32, 3)
+    np.testing.assert_array_equal(a, b)  # same key → same augmentation
+    c = np.asarray(augment.cifar_train_augment(jax.random.PRNGKey(1), imgs))
+    assert not np.allclose(a, c)  # different key → different crops/flips
+
+
+def test_imagenet_mean_subtraction():
+    imgs = np.full((2, 8, 8, 3), 255, np.uint8)
+    out = np.asarray(augment.imagenet_eval_preprocess(imgs))
+    want = 1.0 - np.asarray(augment.VGG_MEANS_01)
+    np.testing.assert_allclose(out[0, 0, 0], want, rtol=1e-5)

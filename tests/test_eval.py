@@ -1,0 +1,71 @@
+"""Evaluator tests — checkpoint-polling sidecar parity
+(reference resnet_cifar_eval.py:85-143) on the virtual 8-device mesh."""
+
+import jax
+import numpy as np
+
+from tpu_resnet.config import load_config
+from tpu_resnet.data.cifar import synthetic_data
+from tpu_resnet.evaluation.evaluator import (
+    _mesh_eval_batch,
+    build_eval_step,
+    evaluate,
+    run_eval_pass,
+)
+from tpu_resnet.parallel import create_mesh, replicated
+from tpu_resnet.train import build_schedule, init_state, train
+import jax.numpy as jnp
+
+from tpu_resnet.models import build_model
+
+
+def test_eval_batch_rounded_to_mesh():
+    cfg = load_config("smoke")
+    cfg.train.eval_batch_size = 100  # reference default, not divisible by 8
+    mesh = create_mesh(cfg.mesh)
+    assert _mesh_eval_batch(cfg, mesh) == 104
+    cfg.train.eval_batch_size = 64
+    assert _mesh_eval_batch(cfg, mesh) == 64
+
+
+def test_run_eval_pass_counts_every_example():
+    cfg = load_config("smoke")
+    cfg.train.eval_batch_size = 100  # forces padding + rounding paths
+    mesh = create_mesh(cfg.mesh)
+    model, eval_step = build_eval_step(cfg, mesh)
+    sched = build_schedule(cfg.optim, cfg.train)
+    state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 32, 32, 3)))
+    state = jax.device_put(state, replicated(mesh))
+    images, labels = synthetic_data(250, 32, 10, seed=5)
+    precision, loss = run_eval_pass(cfg, state, mesh, eval_step,
+                                    images, labels)
+    assert 0.0 <= precision <= 1.0
+    assert np.isfinite(loss)
+
+
+def test_evaluate_once_end_to_end(tmp_path):
+    """train → eval --once → Precision/Best_Precision written
+    (the reference's train+eval sidecar pair, on one mesh)."""
+    cfg = load_config("smoke")
+    cfg.train.train_dir = str(tmp_path / "run")
+    cfg.train.train_steps = 6
+    cfg.train.checkpoint_every = 3
+    cfg.train.log_every = 3
+    cfg.train.global_batch_size = 16
+    cfg.train.eval_once = True
+    train(cfg)
+    precision = evaluate(cfg)
+    assert precision is not None
+    import json, os
+    best = json.load(open(os.path.join(cfg.train.train_dir, "eval",
+                                       "best_precision.json")))
+    assert best["step"] == 6
+    assert best["best_precision"] == precision
+
+
+def test_evaluate_once_no_checkpoint_returns_none(tmp_path):
+    cfg = load_config("smoke")
+    cfg.train.train_dir = str(tmp_path / "empty")
+    cfg.train.eval_once = True
+    assert evaluate(cfg) is None

@@ -1,0 +1,157 @@
+"""Model shape + parameter-count goldens — the role tfprof's param report
+played in the reference (resnet_single.py:58-66), done properly.
+
+The analytic counter below is derived independently from the architecture
+spec (reference resnet_model_official.py:94-366): it knows only the block
+rules, not the Flax implementation, so it catches mis-wired projections,
+BN placement and stage boundaries.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_resnet.models import MLP, cifar_resnet_v2, imagenet_resnet_v2
+from tpu_resnet.train.state import param_count
+
+
+def _bn(c):  # trainable scale+bias (moving stats live in batch_stats)
+    return 2 * c
+
+
+def _conv(k, cin, cout):
+    return k * k * cin * cout
+
+
+def _basic_block(cin, f, project):
+    # preact BN(cin); [proj 1x1 cin->f]; conv 3x3 cin->f; BN(f); conv 3x3 f->f
+    n = _bn(cin) + _conv(3, cin, f) + _bn(f) + _conv(3, f, f)
+    if project:
+        n += _conv(1, cin, f)
+    return n, f
+
+
+def _bottleneck_block(cin, f, project):
+    # preact BN(cin); [proj 1x1 cin->4f]; 1x1 cin->f; BN(f); 3x3 f->f;
+    # BN(f); 1x1 f->4f
+    n = (_bn(cin) + _conv(1, cin, f) + _bn(f) + _conv(3, f, f)
+         + _bn(f) + _conv(1, f, 4 * f))
+    if project:
+        n += _conv(1, cin, 4 * f)
+    return n, 4 * f
+
+
+def expected_cifar_params(resnet_size, num_classes, width=1):
+    # 6n+2 (reference) or 6n+4 (Wide-ResNet convention, width>1)
+    n_blocks = ((resnet_size - 2) // 6 if resnet_size % 6 == 2
+                else (resnet_size - 4) // 6)
+    total = _conv(3, 3, 16)
+    cin = 16
+    for f in (16 * width, 32 * width, 64 * width):
+        for i in range(n_blocks):
+            cnt, cin_new = _basic_block(cin, f, project=(i == 0))
+            total += cnt
+            cin = cin_new
+    total += _bn(cin)  # final BN
+    total += cin * num_classes + num_classes  # dense w + b
+    return total
+
+
+def expected_imagenet_params(layers, bottleneck, num_classes):
+    total = _conv(7, 3, 64)
+    cin = 64
+    block = _bottleneck_block if bottleneck else _basic_block
+    for f, blocks in zip((64, 128, 256, 512), layers):
+        for i in range(blocks):
+            cnt, cin_new = block(cin, f, project=(i == 0))
+            total += cnt
+            cin = cin_new
+    total += _bn(cin)
+    total += cin * num_classes + num_classes
+    return total
+
+
+def _count(model, size):
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, size, size, 3)), train=False)
+    return (param_count(variables["params"]),
+            variables["params"], variables.get("batch_stats", {}))
+
+
+@pytest.mark.parametrize("resnet_size", [8, 20, 50])
+def test_cifar_param_count(resnet_size):
+    model = cifar_resnet_v2(resnet_size, 10, dtype=jnp.float32)
+    n, _, _ = _count(model, 32)
+    assert n == expected_cifar_params(resnet_size, 10)
+
+
+def test_wide_resnet_28_10_param_count():
+    model = cifar_resnet_v2(28, 100, width_multiplier=10, dtype=jnp.float32)
+    n, _, _ = _count(model, 32)
+    assert n == expected_cifar_params(28, 100, width=10)
+    # WRN-28-10 is ~36.5M params in the literature; preact variant here.
+    assert 36_000_000 < n < 37_000_000
+
+
+@pytest.mark.parametrize("resnet_size,layers,bottleneck", [
+    (18, (2, 2, 2, 2), False),
+    (50, (3, 4, 6, 3), True),
+])
+def test_imagenet_param_count(resnet_size, layers, bottleneck):
+    model = imagenet_resnet_v2(resnet_size, 1000, dtype=jnp.float32)
+    n, _, _ = _count(model, 64)  # small spatial size; params size-invariant
+    assert n == expected_imagenet_params(layers, bottleneck, 1000)
+
+
+def test_resnet50_imagenet_is_25m():
+    # ResNet-50-v2 class-1000 trainable params ≈ 25.5M.
+    n = expected_imagenet_params((3, 4, 6, 3), True, 1000)
+    assert 25_000_000 < n < 26_000_000
+
+
+def test_cifar_output_shape_and_dtype():
+    model = cifar_resnet_v2(8, 10, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 32, 32, 3)), train=False)
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32  # logits promoted for stable softmax
+    # params stay fp32 under bf16 compute (mixed precision contract)
+    assert all(x.dtype == jnp.float32
+               for x in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def test_imagenet_output_shape():
+    model = imagenet_resnet_v2(18, 1000, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 224, 224, 3)), train=False)
+    logits = model.apply(variables, jnp.zeros((2, 224, 224, 3)), train=False)
+    assert logits.shape == (2, 1000)
+
+
+def test_batch_stats_update_only_in_train():
+    model = cifar_resnet_v2(8, 10, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, st = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree_util.tree_leaves(variables["batch_stats"])
+    after = jax.tree_util.tree_leaves(st["batch_stats"])
+    assert any(not jnp.allclose(a, b) for a, b in zip(before, after))
+
+
+def test_invalid_sizes_rejected():
+    # reference resnet_model_official.py:233-236 and :360-362
+    with pytest.raises(ValueError):
+        cifar_resnet_v2(33, 10)
+    with pytest.raises(ValueError):
+        imagenet_resnet_v2(42, 1000)
+
+
+def test_mlp_shapes():
+    model = MLP(hidden_units=100, num_classes=10, image_size=32)
+    x = jnp.zeros((3, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (3, 10)
+    n = param_count(variables["params"])
+    assert n == (32 * 32 * 3 * 100 + 100) + (100 * 10 + 10)

@@ -1,0 +1,58 @@
+"""LR schedule goldens against the reference's hook-embedded schedules
+(resnet_cifar_train.py:302-311, resnet_imagenet_train.py:236-260)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resnet.config import RunConfig
+from tpu_resnet.train.schedule import (
+    build_schedule,
+    cifar_piecewise,
+    cosine,
+    imagenet_warmup,
+    piecewise_constant,
+)
+
+
+def test_cifar_piecewise_golden():
+    s = cifar_piecewise()
+    # reference resnet_cifar_train.py:302-311
+    for step, lr in [(0, 0.1), (39_999, 0.1), (40_000, 0.01),
+                     (59_999, 0.01), (60_000, 0.001), (79_999, 0.001),
+                     (80_000, 0.0001), (200_000, 0.0001)]:
+        assert float(s(jnp.int32(step))) == pytest.approx(lr, rel=1e-6), step
+
+
+def test_imagenet_warmup_golden():
+    s = imagenet_warmup()
+    # reference resnet_imagenet_train.py:247-260: linear 0.1→0.4 over 6240,
+    # then 0.4/0.04/0.004/0.0004 at 37440/74880/99840.
+    assert float(s(jnp.int32(0))) == pytest.approx(0.1, rel=1e-5)
+    assert float(s(jnp.int32(3120))) == pytest.approx(0.25, rel=1e-3)
+    assert float(s(jnp.int32(6240))) == pytest.approx(0.4, rel=1e-5)
+    assert float(s(jnp.int32(37_439))) == pytest.approx(0.4, rel=1e-5)
+    assert float(s(jnp.int32(37_440))) == pytest.approx(0.04, rel=1e-5)
+    assert float(s(jnp.int32(74_880))) == pytest.approx(0.004, rel=1e-5)
+    assert float(s(jnp.int32(99_840))) == pytest.approx(0.0004, rel=1e-5)
+
+
+def test_piecewise_validation():
+    with pytest.raises(ValueError):
+        piecewise_constant([10], [1.0])
+
+
+def test_cosine_monotone_decay():
+    s = cosine(1.0, 100, warmup_steps=10)
+    vals = [float(s(jnp.int32(i))) for i in range(0, 101, 10)]
+    assert vals[1] == pytest.approx(1.0, rel=1e-5)
+    assert all(a >= b - 1e-7 for a, b in zip(vals[1:], vals[2:]))
+    assert vals[-1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_build_schedule_dispatch():
+    cfg = RunConfig()
+    for name in ["cifar_piecewise", "imagenet_warmup", "constant", "cosine"]:
+        cfg.optim.schedule = name
+        s = build_schedule(cfg.optim, cfg.train)
+        assert np.isfinite(float(s(jnp.int32(0))))

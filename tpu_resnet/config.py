@@ -1,0 +1,312 @@
+"""Typed run configuration — replaces the reference's per-script flag jungle.
+
+The reference re-declares ~60 ``tf.app.flags`` in every entry script and
+splits hyperparameters across four places: flags, the ``HParams`` namedtuple
+(reference resnet_model.py:36-39), LR schedules embedded in session hooks
+(resnet_cifar_train.py:291-311), and module constants
+(resnet_cifar_train.py:98-100).  Here everything lives in one typed,
+serializable tree of dataclasses with a flat ``--section.field=value`` CLI
+override syntax and named presets matching the reference's published
+configurations (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Input pipeline configuration.
+
+    Mirrors the knobs of reference cifar_input.py:25-119 and the tf.data
+    ``input_fn`` copies (resnet_cifar_train.py:204-247,
+    resnet_imagenet_train.py:161-187) — minus the per-worker-reads-everything
+    design: this pipeline shards files/records per host.
+    """
+
+    dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet | synthetic
+    data_dir: str = ""
+    # Number of worker threads in the host loader (reference uses 16 queue
+    # threads, cifar_input.py:99-100; and num_parallel_calls=4 tf.data maps).
+    num_workers: int = 4
+    # Batches buffered ahead on host + device (prefetch 2x in reference,
+    # resnet_cifar_train.py:233).
+    prefetch: int = 2
+    shuffle_buffer: int = 50_000
+    # ImageNet only: VGG-style resize-side jitter bounds for training
+    # (vgg_preprocessing.py:306-309) and eval resize side (:330).
+    resize_min: int = 256
+    resize_max: int = 512
+    eval_resize: int = 256
+    image_size: int = 0  # 0 = dataset default (32 cifar / 224 imagenet)
+    # Use the native C++ loader when the shared library is built.
+    use_native_loader: bool = True
+
+    @property
+    def num_classes(self) -> int:
+        return {"cifar10": 10, "cifar100": 100, "imagenet": 1000,
+                "synthetic": 10}[self.dataset]
+
+    @property
+    def default_image_size(self) -> int:
+        return 224 if self.dataset == "imagenet" else 32
+
+    @property
+    def resolved_image_size(self) -> int:
+        return self.image_size or self.default_image_size
+
+    @property
+    def train_examples(self) -> int:
+        return {"cifar10": 50_000, "cifar100": 50_000,
+                "imagenet": 1_281_167, "synthetic": 1024}[self.dataset]
+
+    @property
+    def eval_examples(self) -> int:
+        return {"cifar10": 10_000, "cifar100": 10_000,
+                "imagenet": 50_000, "synthetic": 256}[self.dataset]
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Model selection.
+
+    ``resnet_size`` semantics follow the reference exactly: for CIFAR the
+    network is the 6n+2 basic-block ResNet-v2 and size must satisfy
+    ``size % 6 == 2`` (resnet_model_official.py:233-236); for ImageNet the
+    size must be one of 18/34/50/101/152/200 (resnet_model_official.py:352-358).
+    ``width_multiplier`` > 1 turns the CIFAR net into a Wide-ResNet
+    (e.g. WRN-28-10 = resnet_size 28, width 10).
+    """
+
+    name: str = "resnet"  # resnet | mlp
+    resnet_size: int = 50
+    width_multiplier: int = 1
+    # bf16 compute on the MXU with fp32 params/BN stats. "float32" for
+    # bit-exact CPU tests.
+    compute_dtype: str = "bfloat16"
+    # MLP sanity model (reference logist_model.py:11) hidden units.
+    mlp_hidden_units: int = 100
+
+
+@dataclasses.dataclass
+class OptimConfig:
+    """Optimizer + schedule.
+
+    Defaults reproduce the reference recipe: momentum 0.9
+    (resnet_model.py:96-99), L2 weight decay summed over all trainable
+    variables and added to the loss (resnet_model.py:85-86), piecewise LR
+    0.1/0.01/0.001/0.0001 at steps 40k/60k/80k for CIFAR
+    (resnet_cifar_train.py:302-311) or the Intel-Caffe warmup recipe for
+    ImageNet (resnet_imagenet_train.py:236-260).
+    """
+
+    optimizer: str = "momentum"  # sgd | momentum
+    momentum: float = 0.9
+    schedule: str = "cifar_piecewise"  # cifar_piecewise | imagenet_warmup | constant | cosine
+    base_lr: float = 0.1
+    weight_decay: float = 0.0002  # reference _WEIGHT_DECAY for cifar
+    # Reference applies L2 to *all* trainables incl. BN scale/bias
+    # (resnet_model.py:85-86 uses tf.trainable_variables()); set False for the
+    # modern no-decay-on-BN/bias variant.
+    weight_decay_on_bn: bool = True
+    label_smoothing: float = 0.0
+    # warmup schedule knobs (imagenet_warmup)
+    warmup_steps: int = 6240
+    warmup_init_lr: float = 0.1
+    boundaries: tuple = ()  # override schedule boundaries; () = schedule default
+    values: tuple = ()      # override schedule values
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Device mesh. ``data`` is the only axis needed for reference parity
+    (its three distribution modes — PS-sync, async-PS, Horovod — are all data
+    parallelism, SURVEY.md §2.3); ``model`` is there so tensor-style sharding
+    composes without redesign."""
+
+    data: int = -1   # -1 = all remaining devices
+    model: int = 1
+    axis_names: tuple = ("data", "model")
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Training loop parameters (reference trainer flags + hook constants)."""
+
+    train_dir: str = "/tmp/tpu_resnet/train"
+    train_steps: int = 100_000
+    # Global batch across the whole mesh. The reference is ambiguous between
+    # global (Cori: 128/num_nodes per node, submit_ps_cifar_cori_dist.sh:27-31)
+    # and per-worker (ImageNet: 128/node, README.md:39-40); we make global the
+    # source of truth and derive per-device.
+    global_batch_size: int = 128
+    eval_batch_size: int = 100  # reference resnet_cifar_eval.py: batch 100
+    log_every: int = 20          # LoggingTensorHook interval (resnet_cifar_train.py:282-287)
+    summary_every: int = 100     # SummarySaverHook interval (:275-280)
+    checkpoint_every: int = 1000  # save_checkpoint_steps (:335)
+    keep_checkpoints: int = 5
+    seed: int = 0
+    # Continuous-eval sidecar (resnet_cifar_eval.py:140-143)
+    eval_interval_secs: int = 60
+    eval_once: bool = False
+
+
+@dataclasses.dataclass
+class RunConfig:
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+
+    # ---------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=list)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "RunConfig":
+        cfg = cls()
+        for section_name, section_val in d.items():
+            section = getattr(cfg, section_name)
+            for k, v in section_val.items():
+                if not hasattr(section, k):
+                    raise ValueError(f"unknown config field {section_name}.{k}")
+                cur = getattr(section, k)
+                if isinstance(cur, tuple) and isinstance(v, list):
+                    v = tuple(v)
+                setattr(section, k, v)
+        return cfg
+
+    # ------------------------------------------------------------------- CLI
+    def apply_overrides(self, overrides: Sequence[str]) -> "RunConfig":
+        """Apply ``section.field=value`` strings (the CLI surface)."""
+        for ov in overrides:
+            if "=" not in ov:
+                raise ValueError(f"override must be section.field=value: {ov!r}")
+            key, raw = ov.split("=", 1)
+            parts = key.lstrip("-").split(".")
+            if len(parts) != 2:
+                raise ValueError(f"override key must be section.field: {key!r}")
+            section_name, field = parts
+            section = getattr(self, section_name, None)
+            if section is None or not hasattr(section, field):
+                raise ValueError(f"unknown config field {key!r}")
+            cur = getattr(section, field)
+            setattr(section, field, _parse_value(raw, cur))
+        return self
+
+
+def _parse_value(raw: str, current: Any) -> Any:
+    if isinstance(current, bool):
+        if raw.lower() in ("1", "true", "yes"):
+            return True
+        if raw.lower() in ("0", "false", "no"):
+            return False
+        raise ValueError(f"bad bool {raw!r}")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, tuple):
+        return tuple(json.loads(raw))
+    return raw
+
+
+# ---------------------------------------------------------------- presets
+def _cifar_local() -> RunConfig:
+    """Reference 'local' config: ResNet-50(6n+2) CIFAR-10, batch 128,
+    piecewise LR, ~80k steps → 93.6% (README.md:28)."""
+    cfg = RunConfig()
+    cfg.data.dataset = "cifar10"
+    cfg.model.resnet_size = 50
+    cfg.optim.schedule = "cifar_piecewise"
+    cfg.optim.weight_decay = 0.0002
+    cfg.train.train_steps = 90_000
+    cfg.train.global_batch_size = 128
+    return cfg
+
+
+def _cifar100() -> RunConfig:
+    cfg = _cifar_local()
+    cfg.data.dataset = "cifar100"
+    return cfg
+
+
+def _wrn_28_10_cifar100() -> RunConfig:
+    """Wide-ResNet-28-10 on CIFAR-100 (BASELINE.json configs[3])."""
+    cfg = _cifar_local()
+    cfg.data.dataset = "cifar100"
+    cfg.model.resnet_size = 28
+    cfg.model.width_multiplier = 10
+    cfg.optim.weight_decay = 0.0005
+    return cfg
+
+
+def _imagenet() -> RunConfig:
+    """ResNet-50 ImageNet, Intel-Caffe 8-node recipe: global batch 1024,
+    warmup 0.1→0.4 over 6240 steps then /10 at 37440/74880/99840, weight
+    decay 1e-4, 90 epochs = 112600 steps
+    (resnet_imagenet_train.py:236-260, submit_imagenet_daint_dist.sh:38-40)."""
+    cfg = RunConfig()
+    cfg.data.dataset = "imagenet"
+    cfg.model.resnet_size = 50
+    cfg.optim.schedule = "imagenet_warmup"
+    cfg.optim.weight_decay = 1e-4
+    cfg.train.train_steps = 112_600
+    cfg.train.global_batch_size = 1024
+    cfg.train.eval_batch_size = 125
+    return cfg
+
+
+def _smoke() -> RunConfig:
+    """Laptop-scale smoke config — the reference's only integration test
+    (mkl-scripts/submit_mac_dist.sh: batch 10, 100 steps)."""
+    cfg = RunConfig()
+    cfg.data.dataset = "synthetic"
+    cfg.model.resnet_size = 8
+    cfg.model.compute_dtype = "float32"
+    cfg.train.train_steps = 100
+    cfg.train.global_batch_size = 16
+    cfg.train.checkpoint_every = 50
+    cfg.optim.schedule = "constant"
+    cfg.optim.base_lr = 0.01
+    return cfg
+
+
+PRESETS = {
+    "cifar10": _cifar_local,
+    "cifar100": _cifar100,
+    "wrn28_10_cifar100": _wrn_28_10_cifar100,
+    "imagenet": _imagenet,
+    "smoke": _smoke,
+}
+
+
+def load_config(preset: str = "", config_file: str = "",
+                overrides: Sequence[str] = ()) -> RunConfig:
+    if preset:
+        if preset not in PRESETS:
+            raise ValueError(f"unknown preset {preset!r}; have {sorted(PRESETS)}")
+        cfg = PRESETS[preset]()
+    elif config_file:
+        with open(config_file) as f:
+            cfg = RunConfig.from_dict(json.load(f))
+    else:
+        cfg = RunConfig()
+    return cfg.apply_overrides(overrides)
+
+
+def build_arg_parser(description: str = "") -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--preset", default="", help=f"one of {sorted(PRESETS)}")
+    p.add_argument("--config", default="", help="JSON config file")
+    p.add_argument("overrides", nargs="*",
+                   help="section.field=value overrides")
+    return p

@@ -1,0 +1,19 @@
+from tpu_resnet.data.augment import get_augment_fns
+from tpu_resnet.data.cifar import load_cifar, load_split, synthetic_data
+from tpu_resnet.data.pipeline import (
+    BackgroundIterator,
+    ShardedBatcher,
+    device_prefetch,
+    eval_batches,
+)
+
+__all__ = [
+    "get_augment_fns",
+    "load_cifar",
+    "load_split",
+    "synthetic_data",
+    "BackgroundIterator",
+    "ShardedBatcher",
+    "device_prefetch",
+    "eval_batches",
+]

@@ -1,0 +1,108 @@
+"""CIFAR-10/100 binary-format readers + synthetic data.
+
+Formats (reference cifar_input.py:39-53):
+- cifar10: records of 1 label byte + 3072 image bytes (depth-major
+  3×32×32), files ``cifar-10-batches-bin/data_batch_{1..5}.bin`` and
+  ``test_batch.bin`` (reference resnet_cifar_train.py:141-155).
+- cifar100: records of 1 coarse + 1 fine label byte + 3072 image bytes —
+  the reference reads the *fine* label via ``label_offset=1``
+  (cifar_input.py:44-47); files ``cifar-100-binary/train.bin``, ``test.bin``.
+
+The whole dataset (~180 MB) is loaded into host RAM once as uint8 NHWC — no
+per-record reader processes; the per-step path never touches disk. A native
+C++ reader (tpu_resnet/native) accelerates the one-time decode when built;
+the numpy path below is the always-available fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+_IMAGE_BYTES = 32 * 32 * 3
+
+
+def _decode_records(raw: np.ndarray, label_offset: int) -> Tuple[np.ndarray, np.ndarray]:
+    """raw uint8 [N, record_bytes] → (images NHWC uint8, labels int32)."""
+    labels = raw[:, label_offset].astype(np.int32)
+    images = raw[:, label_offset + 1:label_offset + 1 + _IMAGE_BYTES]
+    # depth-major [C,H,W] → NHWC (reference cifar_input.py:64-68)
+    images = images.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(images), labels
+
+
+def _read_files(files: List[str], record_bytes: int) -> np.ndarray:
+    parts = []
+    for f in files:
+        buf = np.fromfile(f, dtype=np.uint8)
+        if buf.size % record_bytes:
+            raise ValueError(f"{f}: size {buf.size} not a multiple of "
+                             f"record_bytes {record_bytes}")
+        parts.append(buf.reshape(-1, record_bytes))
+    return np.concatenate(parts)
+
+
+def cifar_files(dataset: str, data_dir: str, train: bool) -> List[str]:
+    if dataset == "cifar10":
+        d = os.path.join(data_dir, "cifar-10-batches-bin")
+        if not os.path.isdir(d):
+            d = data_dir
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+    elif dataset == "cifar100":
+        d = os.path.join(data_dir, "cifar-100-binary")
+        if not os.path.isdir(d):
+            d = data_dir
+        names = ["train.bin"] if train else ["test.bin"]
+    else:
+        raise ValueError(f"not a cifar dataset: {dataset}")
+    files = [os.path.join(d, n) for n in names]
+    missing = [f for f in files if not os.path.exists(f)]
+    if missing:
+        raise FileNotFoundError(f"missing CIFAR files: {missing}")
+    return files
+
+
+def load_cifar(dataset: str, data_dir: str, train: bool,
+               use_native: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    label_offset = 1 if dataset == "cifar100" else 0
+    record_bytes = 1 + label_offset + _IMAGE_BYTES
+    files = cifar_files(dataset, data_dir, train)
+    raw = None
+    if use_native:
+        try:
+            from tpu_resnet.native import loader as native_loader
+            raw = native_loader.read_fixed_length_records(files, record_bytes)
+        except ImportError:
+            raw = None
+    if raw is None:
+        raw = _read_files(files, record_bytes)
+    return _decode_records(raw, label_offset)
+
+
+def synthetic_data(num_examples: int, image_size: int = 32,
+                   num_classes: int = 10, seed: int = 0
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic random images/labels for smoke tests and benchmarks
+    (the role of the reference's batch_size=10 localhost configs,
+    mkl-scripts/run_dist_tf_local.sh:14-21)."""
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 256, (num_examples, image_size, image_size, 3),
+                          dtype=np.uint8)
+    labels = rng.integers(0, num_classes, (num_examples,), dtype=np.int32)
+    return images, labels
+
+
+def load_split(cfg, train: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Dispatch on DataConfig (in-memory datasets; ImageNet streams through
+    tpu_resnet.data.imagenet instead)."""
+    if cfg.dataset in ("cifar10", "cifar100"):
+        return load_cifar(cfg.dataset, cfg.data_dir, train,
+                          use_native=cfg.use_native_loader)
+    if cfg.dataset == "synthetic":
+        n = cfg.train_examples if train else cfg.eval_examples
+        return synthetic_data(n, cfg.resolved_image_size, cfg.num_classes,
+                              seed=0 if train else 1)
+    raise ValueError(f"load_split does not handle {cfg.dataset!r}")

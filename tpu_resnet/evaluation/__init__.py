@@ -1,0 +1,7 @@
+from tpu_resnet.evaluation.evaluator import (
+    build_eval_step,
+    evaluate,
+    run_eval_pass,
+)
+
+__all__ = ["build_eval_step", "evaluate", "run_eval_pass"]

@@ -1,0 +1,138 @@
+"""Continuous checkpoint-polling evaluator — the reference's eval sidecar
+(reference resnet_cifar_eval.py:85-143, resnet_imagenet_eval.py:169-230)
+rebuilt: poll the train dir for a new checkpoint, restore, run the eval
+split, write ``Precision`` / ``Best_Precision`` against the restored step,
+sleep ``eval_interval_secs`` (60 s), repeat; ``eval_once`` evaluates the
+latest checkpoint and exits (resnet_cifar_eval.py:140-143).
+
+Deviations from the reference, on purpose:
+- the full test split is evaluated (the reference samples 50×100 = 5000 of
+  CIFAR's 10000 test images, resnet_cifar_eval.py:114-117);
+- ``best_precision`` is persisted to ``best_precision.json`` so evaluator
+  restarts don't reset the best curve (the reference loses it,
+  README.md:33).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_resnet import parallel
+from tpu_resnet.config import RunConfig
+from tpu_resnet.data import augment as aug_lib
+from tpu_resnet.data import cifar as cifar_data
+from tpu_resnet.data import pipeline
+from tpu_resnet.models import build_model
+from tpu_resnet.train import schedule as sched_lib
+from tpu_resnet.train.checkpoint import CheckpointManager, latest_step_in
+from tpu_resnet.train.metrics_io import MetricsWriter
+from tpu_resnet.train.state import init_state
+from tpu_resnet.train.step import make_eval_step
+
+log = logging.getLogger("tpu_resnet")
+
+
+def _mesh_eval_batch(cfg: RunConfig, mesh) -> int:
+    """Round the configured eval batch (reference default 100,
+    resnet_cifar_eval.py) up to a multiple of the mesh data axis; padded
+    slots are masked out, so the rounding never changes results."""
+    n_data = mesh.shape["data"]
+    bs = cfg.train.eval_batch_size
+    return ((bs + n_data - 1) // n_data) * n_data
+
+
+def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn,
+                  images: np.ndarray, labels: np.ndarray
+                  ) -> Tuple[float, float]:
+    """One full pass over the eval split → (precision, mean_loss)."""
+    sharding = parallel.batch_sharding(mesh)
+    correct = loss_sum = count = 0
+    for img, lab in pipeline.eval_batches(images, labels,
+                                          _mesh_eval_batch(cfg, mesh)):
+        gi = jax.device_put(img, sharding)
+        gl = jax.device_put(lab, sharding)
+        c, ls, n = eval_step_fn(state, gi, gl)
+        correct += int(c)
+        loss_sum += float(ls)
+        count += int(n)
+    return correct / max(count, 1), loss_sum / max(count, 1)
+
+
+def build_eval_step(cfg: RunConfig, mesh):
+    model = build_model(cfg)
+    _, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
+    step = make_eval_step(model, cfg.data.num_classes, eval_pre)
+    return model, jax.jit(step, in_shardings=(
+        parallel.replicated(mesh), parallel.batch_sharding(mesh),
+        parallel.batch_sharding(mesh)))
+
+
+def _template_state(cfg: RunConfig, model, mesh):
+    schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
+    size = cfg.data.resolved_image_size
+    state = init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
+                       jnp.zeros((1, size, size, 3)))
+    return jax.device_put(state, parallel.replicated(mesh))
+
+
+def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
+    """Continuous (or once) evaluation; returns last precision."""
+    if mesh is None:
+        mesh = parallel.create_mesh(cfg.mesh)
+    model, eval_step_fn = build_eval_step(cfg, mesh)
+    template = _template_state(cfg, model, mesh)
+    images, labels = cifar_data.load_split(cfg.data, train=False)
+
+    eval_dir = os.path.join(cfg.train.train_dir, "eval")
+    metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())
+    best_file = os.path.join(eval_dir, "best_precision.json")
+    best = 0.0
+    if os.path.exists(best_file):  # survive evaluator restarts (README.md:33)
+        with open(best_file) as f:
+            best = json.load(f)["best_precision"]
+
+    ckpt = CheckpointManager(cfg.train.train_dir,
+                             keep=cfg.train.keep_checkpoints)
+    last_seen = None
+    precision = None
+    while True:
+        step = latest_step_in(cfg.train.train_dir)
+        if step is None:
+            # Checkpoint not there yet — keep polling like the reference
+            # (resnet_cifar_eval.py:100-109).
+            log.info("no checkpoint yet in %s; sleeping", cfg.train.train_dir)
+            if cfg.train.eval_once:
+                return None
+            time.sleep(cfg.train.eval_interval_secs)
+            continue
+        if step != last_seen:
+            state = ckpt.restore(template, step=step)
+            t0 = time.perf_counter()
+            precision, loss = run_eval_pass(cfg, state, mesh, eval_step_fn,
+                                            images, labels)
+            dt = time.perf_counter() - t0
+            best = max(best, precision)
+            if parallel.is_primary():
+                os.makedirs(eval_dir, exist_ok=True)
+                with open(best_file, "w") as f:
+                    json.dump({"best_precision": best, "step": step}, f)
+            metrics.write(step, {"Precision": precision,
+                                 "Best_Precision": best,
+                                 "eval_loss": loss})
+            log.info("eval @ step %d: precision %.4f best %.4f loss %.4f "
+                     "(%.1fs, %d examples)", step, precision, best, loss,
+                     dt, len(images))
+            last_seen = step
+        if cfg.train.eval_once:
+            break
+        time.sleep(cfg.train.eval_interval_secs)
+    metrics.close()
+    return precision

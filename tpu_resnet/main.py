@@ -1,0 +1,73 @@
+"""Unified CLI — replaces the reference's nine overlapping entry scripts
+(resnet_single.py, resnet_cifar_train.py, resnet_cifar_main.py,
+resnet_imagenet_train.py, the eval sidecars and predict tools — SURVEY.md §1
+L4) with one command:
+
+    python -m tpu_resnet train --preset cifar10 train.train_dir=/tmp/run
+    python -m tpu_resnet eval  --preset cifar10 train.train_dir=/tmp/run
+    python -m tpu_resnet info  --preset imagenet
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def _setup_logging():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+        datefmt="%H:%M:%S",
+        stream=sys.stderr,
+    )
+
+
+def main(argv=None):
+    _setup_logging()
+    parser = argparse.ArgumentParser(prog="tpu_resnet")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name, help_text in [
+        ("train", "run the training loop"),
+        ("eval", "continuous checkpoint-polling evaluation (or --once)"),
+        ("info", "print resolved config, param count and per-step FLOPs"),
+    ]:
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument("--preset", default="")
+        p.add_argument("--config", default="")
+        p.add_argument("overrides", nargs="*")
+        if name == "eval":
+            p.add_argument("--once", action="store_true",
+                           help="evaluate latest checkpoint once and exit")
+    args = parser.parse_args(argv)
+
+    from tpu_resnet.config import load_config
+    cfg = load_config(args.preset, args.config, args.overrides)
+
+    if args.command == "train":
+        from tpu_resnet import parallel
+        from tpu_resnet.train import train
+        parallel.initialize()
+        train(cfg)
+        return 0
+
+    if args.command == "eval":
+        from tpu_resnet import parallel
+        from tpu_resnet.evaluation import evaluate
+        parallel.initialize()
+        if args.once:
+            cfg.train.eval_once = True
+        evaluate(cfg)
+        return 0
+
+    if args.command == "info":
+        from tpu_resnet.tools.analysis import print_model_info
+        print_model_info(cfg)
+        return 0
+
+    parser.error(f"unknown command {args.command}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Model registry — replaces the reference's per-trainer hardcoded
+cifar/imagenet dispatch (reference resnet_model.py:71-74) and the abandoned
+config-driven registry sketch (reference models/__init__.py:1-21)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpu_resnet.models.mlp import MLP
+from tpu_resnet.models.resnet import (
+    ResNetV2,
+    cifar_resnet_v2,
+    imagenet_resnet_v2,
+)
+
+__all__ = [
+    "MLP",
+    "ResNetV2",
+    "cifar_resnet_v2",
+    "imagenet_resnet_v2",
+    "build_model",
+]
+
+
+def build_model(cfg):
+    """Build the model from a ``RunConfig`` (tpu_resnet.config.RunConfig)."""
+    dtype = jnp.dtype(cfg.model.compute_dtype)
+    if cfg.model.name == "mlp":
+        return MLP(hidden_units=cfg.model.mlp_hidden_units,
+                   num_classes=cfg.data.num_classes,
+                   image_size=cfg.data.resolved_image_size)
+    if cfg.model.name != "resnet":
+        raise ValueError(f"unknown model {cfg.model.name!r}")
+    if cfg.data.dataset == "imagenet":
+        return imagenet_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
+                                  dtype=dtype)
+    return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
+                           width_multiplier=cfg.model.width_multiplier,
+                           dtype=dtype)

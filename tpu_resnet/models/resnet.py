@@ -1,0 +1,300 @@
+"""Pre-activation ResNet-v2 in Flax — TPU-native rebuild of the reference
+model (reference: resnet_model_official.py).
+
+Parity notes (reference file:line):
+- BatchNorm momentum 0.997, epsilon 1e-5, scale+center
+  (resnet_model_official.py:37-48). TF ``fused=True`` is irrelevant here —
+  XLA:TPU fuses BN into neighboring ops automatically.
+- ``fixed_padding`` for strided convs: explicit (k-1)//2 padding so the
+  padding depends only on kernel size, not input size
+  (resnet_model_official.py:53-91).
+- Building block / bottleneck block with BN+ReLU *before* convs and the
+  projection shortcut taken from the pre-activated input
+  (resnet_model_official.py:94-175).
+- CIFAR generator: 6n+2 sizing (``resnet_size % 6 == 2``), 3×3/1 stem with
+  16 filters, three stages 16/32/64 with strides 1/2/2, final BN+ReLU +
+  global average pool + dense (resnet_model_official.py:217-278).
+- ImageNet generator: 7×7/2 stem with 64 filters + 3×3/2 'SAME' max-pool,
+  four stages 64/128/256/512 with strides 1/2/2/2, sizes
+  18/34/50/101/152/200 (resnet_model_official.py:281-366).
+- Conv init: variance_scaling(scale=1.0, fan_in, truncated_normal) — the
+  tf.variance_scaling_initializer() default (resnet_model_official.py:90).
+  Dense init: glorot_uniform (tf.layers.dense default).
+
+TPU-first deviations from the reference design (not behavior):
+- Always NHWC; no data_format flag. XLA:TPU picks layouts itself; the
+  reference's channels_first/cuDNN vs channels_last/MKL switch
+  (resnet_cifar_train.py:80-81) is a GPU/CPU artifact with no TPU analog.
+- Mixed precision: conv/matmul compute in ``compute_dtype`` (bfloat16 on the
+  MXU), parameters and BN statistics in float32, logits returned in float32.
+- The final average pool is a global spatial mean — identical to the
+  reference's 8×8 (CIFAR) / 7×7 (ImageNet) VALID pool at native resolutions
+  (resnet_model_official.py:269-274, :337-344) and well-defined at others.
+- ``width_multiplier`` generalizes the CIFAR net to Wide-ResNet (WRN-28-10 =
+  resnet_size 28, width 10).
+- Optional ``bn_axis_name`` enables cross-replica (synced) BatchNorm under
+  ``shard_map``; default None matches the reference's per-replica BN
+  statistics (resnet_model.py:120-122).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Dtype = Any
+
+_BATCH_NORM_MOMENTUM = 0.997
+_BATCH_NORM_EPSILON = 1e-5
+
+conv_kernel_init = nn.initializers.variance_scaling(
+    1.0, "fan_in", "truncated_normal")
+dense_kernel_init = nn.initializers.xavier_uniform()
+
+
+class BatchNormRelu(nn.Module):
+    """BN (fp32 stats/params) then ReLU, computing in ``dtype``."""
+
+    dtype: Dtype = jnp.float32
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=_BATCH_NORM_MOMENTUM,
+            epsilon=_BATCH_NORM_EPSILON,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.axis_name if train else None,
+            name="bn",
+        )(x)
+        return nn.relu(x)
+
+
+class ConvFixedPadding(nn.Module):
+    """Strided conv with input-size-independent explicit padding
+    (reference resnet_model_official.py:53-91)."""
+
+    filters: int
+    kernel_size: int
+    strides: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k, s = self.kernel_size, self.strides
+        if s > 1:
+            pad_total = k - 1
+            pad_beg = pad_total // 2
+            pad_end = pad_total - pad_beg
+            padding = [(pad_beg, pad_end), (pad_beg, pad_end)]
+        else:
+            padding = "SAME"
+        return nn.Conv(
+            features=self.filters,
+            kernel_size=(k, k),
+            strides=(s, s),
+            padding=padding,
+            use_bias=False,
+            kernel_init=conv_kernel_init,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="conv",
+        )(x)
+
+
+class BuildingBlock(nn.Module):
+    """Basic 3×3+3×3 pre-activation block
+    (reference resnet_model_official.py:94-130)."""
+
+    filters: int
+    strides: int
+    use_projection: bool
+    dtype: Dtype = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        shortcut = x
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
+            x, train=train)
+        if self.use_projection:
+            # Projection comes after the first BN+ReLU: it convolves the
+            # pre-activated input (resnet_model_official.py:117-120).
+            shortcut = ConvFixedPadding(
+                self.filters, 1, self.strides, self.dtype, name="proj")(x)
+        x = ConvFixedPadding(
+            self.filters, 3, self.strides, self.dtype, name="conv1")(x)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu1")(
+            x, train=train)
+        x = ConvFixedPadding(self.filters, 3, 1, self.dtype, name="conv2")(x)
+        return x + shortcut
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 → 3×3 → 1×1(4f) pre-activation bottleneck
+    (reference resnet_model_official.py:133-175)."""
+
+    filters: int
+    strides: int
+    use_projection: bool
+    dtype: Dtype = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        shortcut = x
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
+            x, train=train)
+        if self.use_projection:
+            shortcut = ConvFixedPadding(
+                4 * self.filters, 1, self.strides, self.dtype, name="proj")(x)
+        x = ConvFixedPadding(self.filters, 1, 1, self.dtype, name="conv1")(x)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu1")(
+            x, train=train)
+        x = ConvFixedPadding(
+            self.filters, 3, self.strides, self.dtype, name="conv2")(x)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu2")(
+            x, train=train)
+        x = ConvFixedPadding(4 * self.filters, 1, 1, self.dtype, name="conv3")(x)
+        return x + shortcut
+
+
+class BlockLayer(nn.Module):
+    """A stage of blocks; only the first block projects/strides
+    (reference resnet_model_official.py:178-214)."""
+
+    filters: int
+    blocks: int
+    strides: int
+    bottleneck: bool
+    dtype: Dtype = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        block_cls = BottleneckBlock if self.bottleneck else BuildingBlock
+        x = block_cls(self.filters, self.strides, True, self.dtype,
+                      self.bn_axis_name, name="block0")(x, train=train)
+        for i in range(1, self.blocks):
+            x = block_cls(self.filters, 1, False, self.dtype,
+                          self.bn_axis_name, name=f"block{i}")(x, train=train)
+        return x
+
+
+class ResNetV2(nn.Module):
+    """Generic pre-activation ResNet-v2 over NHWC inputs.
+
+    ``stem='cifar'``: 3×3/1 conv, no max-pool; ``stem='imagenet'``:
+    7×7/2 conv + 3×3/2 SAME max-pool.
+    """
+
+    stage_filters: Sequence[int]
+    stage_blocks: Sequence[int]
+    stage_strides: Sequence[int]
+    bottleneck: bool
+    num_classes: int
+    stem: str = "imagenet"
+    stem_filters: int = 64
+    dtype: Dtype = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = jnp.asarray(x, self.dtype)
+        if self.stem == "cifar":
+            x = ConvFixedPadding(self.stem_filters, 3, 1, self.dtype,
+                                 name="initial_conv")(x)
+        elif self.stem == "imagenet":
+            x = ConvFixedPadding(self.stem_filters, 7, 2, self.dtype,
+                                 name="initial_conv")(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+
+        for i, (f, b, s) in enumerate(zip(self.stage_filters,
+                                          self.stage_blocks,
+                                          self.stage_strides)):
+            x = BlockLayer(f, b, s, self.bottleneck, self.dtype,
+                           self.bn_axis_name, name=f"block_layer{i + 1}")(
+                x, train=train)
+
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="final_bnrelu")(
+            x, train=train)
+        # Global spatial mean == the reference's full-extent VALID avg-pool
+        # (resnet_model_official.py:269-274, :337-344).
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, kernel_init=dense_kernel_init,
+                     dtype=self.dtype, param_dtype=jnp.float32,
+                     name="final_dense")(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+def cifar_resnet_v2(resnet_size: int, num_classes: int,
+                    width_multiplier: int = 1,
+                    dtype: Dtype = jnp.bfloat16,
+                    bn_axis_name: Optional[str] = None) -> ResNetV2:
+    """6n+2 CIFAR ResNet-v2 (reference resnet_model_official.py:217-278).
+
+    'ResNet-50' on CIFAR means n=8 basic blocks per stage with filters
+    16/32/64 — not the ImageNet bottleneck net (SURVEY.md §2.1).
+
+    With ``width_multiplier`` > 1, the Wide-ResNet 6n+4 depth convention is
+    also accepted (WRN-28-10 = size 28, n=4, width 10).
+    """
+    if resnet_size % 6 == 2:
+        n = (resnet_size - 2) // 6
+    elif resnet_size % 6 == 4 and width_multiplier > 1:
+        n = (resnet_size - 4) // 6
+    else:
+        raise ValueError(f"resnet_size must be 6n+2 (or 6n+4 for wide), "
+                         f"got {resnet_size}")
+    w = width_multiplier
+    return ResNetV2(
+        stage_filters=(16 * w, 32 * w, 64 * w),
+        stage_blocks=(n, n, n),
+        stage_strides=(1, 2, 2),
+        bottleneck=False,
+        num_classes=num_classes,
+        stem="cifar",
+        stem_filters=16,
+        dtype=dtype,
+        bn_axis_name=bn_axis_name,
+    )
+
+
+_IMAGENET_PARAMS = {
+    # size: (bottleneck, stage_blocks) — resnet_model_official.py:352-358
+    18: (False, (2, 2, 2, 2)),
+    34: (False, (3, 4, 6, 3)),
+    50: (True, (3, 4, 6, 3)),
+    101: (True, (3, 4, 23, 3)),
+    152: (True, (3, 8, 36, 3)),
+    200: (True, (3, 24, 36, 3)),
+}
+
+
+def imagenet_resnet_v2(resnet_size: int, num_classes: int,
+                       dtype: Dtype = jnp.bfloat16,
+                       bn_axis_name: Optional[str] = None) -> ResNetV2:
+    """ImageNet ResNet-v2 18/34/50/101/152/200
+    (reference resnet_model_official.py:350-366)."""
+    if resnet_size not in _IMAGENET_PARAMS:
+        raise ValueError(
+            f"invalid resnet_size {resnet_size}; have {sorted(_IMAGENET_PARAMS)}")
+    bottleneck, blocks = _IMAGENET_PARAMS[resnet_size]
+    return ResNetV2(
+        stage_filters=(64, 128, 256, 512),
+        stage_blocks=blocks,
+        stage_strides=(1, 2, 2, 2),
+        bottleneck=bottleneck,
+        num_classes=num_classes,
+        stem="imagenet",
+        stem_filters=64,
+        dtype=dtype,
+        bn_axis_name=bn_axis_name,
+    )

@@ -1,0 +1,18 @@
+from tpu_resnet.parallel.mesh import (
+    batch_sharding,
+    check_divisible,
+    create_mesh,
+    local_batch_size,
+    replicated,
+)
+from tpu_resnet.parallel.multihost import initialize, is_primary
+
+__all__ = [
+    "batch_sharding",
+    "check_divisible",
+    "create_mesh",
+    "local_batch_size",
+    "replicated",
+    "initialize",
+    "is_primary",
+]

@@ -1,0 +1,68 @@
+"""Device mesh construction — the TPU-native replacement for the reference's
+entire cluster topology layer.
+
+Where the reference assembles ``ps_hosts``/``worker_hosts`` strings, starts a
+gRPC ``tf.train.Server`` per task and places variables on parameter servers
+(reference resnet_cifar_train.py:371-403), a JAX program sees every chip in
+the slice and expresses distribution as shardings over one
+``jax.sharding.Mesh``. Gradient aggregation becomes an XLA all-reduce over
+ICI — the single code path that subsumes the reference's PS-sync, async-PS
+and Horovod modes (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(mesh_cfg=None, devices: Optional[Sequence[jax.Device]] = None
+                ) -> Mesh:
+    """Build a (data, model) mesh from MeshConfig.
+
+    ``data=-1`` consumes all devices not claimed by other axes. Reference
+    parity only needs the data axis; the model axis (default size 1) keeps
+    tensor-style shardings expressible without redesign.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    model = getattr(mesh_cfg, "model", 1) if mesh_cfg is not None else 1
+    data = getattr(mesh_cfg, "data", -1) if mesh_cfg is not None else -1
+    if data == -1:
+        if n % model:
+            raise ValueError(f"{n} devices not divisible by model={model}")
+        data = n // model
+    if data * model != n:
+        raise ValueError(f"mesh {data}x{model} != {n} devices")
+    axis_names = tuple(getattr(mesh_cfg, "axis_names", ("data", "model"))
+                       if mesh_cfg is not None else ("data", "model"))
+    dev_array = np.asarray(devices).reshape(data, model)
+    return Mesh(dev_array, axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) axis split over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-process batch for the host input pipeline."""
+    n_proc = jax.process_count()
+    if global_batch % n_proc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {n_proc} processes")
+    return global_batch // n_proc
+
+
+def check_divisible(global_batch: int, mesh: Mesh) -> None:
+    n_data = mesh.shape["data"]
+    if global_batch % n_data:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data axis {n_data}")

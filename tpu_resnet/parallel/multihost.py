@@ -1,0 +1,65 @@
+"""Multi-host runtime initialization — replaces the reference's two process
+bring-up stacks (TF gRPC server per ps/worker task,
+reference resnet_cifar_train.py:382-387; and mpirun/ssh + MPI rendezvous for
+Horovod, start-resnet-cifar-horovod-train.sh:119-125).
+
+On TPU the launcher's only topology job is "start one process per host and
+point it at a coordinator" — ``jax.distributed.initialize`` does rendezvous
+over DCN, after which every process sees the global device set and the same
+SPMD program runs everywhere. No ps processes, no ssh mesh, no NCCL env
+plumbing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Initialize multi-host JAX if a cluster is configured.
+
+    Resolution order:
+    1. explicit args,
+    2. env vars ``TPU_COORDINATOR_ADDRESS`` / ``TPU_NUM_PROCESSES`` /
+       ``TPU_PROCESS_ID`` (set by launch/ scripts — the analog of the
+       reference's ``TF_PS_HOSTS``/``TF_WORKER_HOSTS`` env protocol,
+       mkl-scripts/run_dist_tf_daint.sh:4-28),
+    3. TPU-VM / Slurm auto-detection inside ``jax.distributed.initialize``.
+
+    Single-process runs (no coordinator configured) are a no-op, matching the
+    reference's serial branch (resnet_cifar_train.py:313-326).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "TPU_COORDINATOR_ADDRESS")
+    if num_processes is None and "TPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["TPU_NUM_PROCESSES"])
+    if process_id is None and "TPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["TPU_PROCESS_ID"])
+
+    if coordinator_address is None and num_processes is None:
+        log.info("single-process run; skipping jax.distributed.initialize")
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info("multi-host initialized: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def is_primary() -> bool:
+    """True on the process that owns checkpointing/logging — the analog of
+    the reference's chief worker / Horovod rank 0
+    (resnet_cifar_main.py:328, resnet_cifar_train.py:334)."""
+    return jax.process_index() == 0

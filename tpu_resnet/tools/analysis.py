@@ -1,0 +1,53 @@
+"""Model analysis — the tfprof replacement (reference resnet_single.py:58-66
+dumped parameter counts and FLOPs via tf.profiler). Here: param count from
+the pytree and per-step FLOPs from XLA's own compiled cost analysis."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_resnet.config import RunConfig
+from tpu_resnet.models import build_model
+from tpu_resnet.train.state import param_count
+
+
+def forward_cost_analysis(model, image_size: int, batch: int = 1):
+    """XLA cost analysis of the inference forward pass."""
+    x = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), x,
+                                                  train=False))
+
+    def fwd(v, x):
+        return model.apply(v, x, train=False)
+
+    lowered = jax.jit(fwd).lower(variables, x)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0]
+    return cost or {}
+
+
+def print_model_info(cfg: RunConfig):
+    model = build_model(cfg)
+    size = cfg.data.resolved_image_size
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, size, size, 3)), train=False)
+    n_params = param_count(variables["params"])
+    n_stats = param_count(variables.get("batch_stats", {}))
+    print(cfg.to_json())
+    print(f"model: {cfg.model.name} size={cfg.model.resnet_size} "
+          f"width={cfg.model.width_multiplier} dataset={cfg.data.dataset}")
+    print(f"trainable params: {n_params:,}")
+    print(f"batch-norm moving stats: {n_stats:,}")
+    try:
+        cost = forward_cost_analysis(model, size)
+        flops = cost.get("flops")
+        if flops:
+            print(f"forward FLOPs/example (XLA estimate): {int(flops):,}")
+        bytes_ = cost.get("bytes accessed")
+        if bytes_:
+            print(f"forward bytes accessed/example: {int(bytes_):,}")
+    except Exception as e:  # cost analysis is best-effort per backend
+        print(f"cost analysis unavailable: {e}")

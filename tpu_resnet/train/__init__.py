@@ -1,0 +1,25 @@
+from tpu_resnet.train.checkpoint import CheckpointManager, latest_step_in
+from tpu_resnet.train.loop import train
+from tpu_resnet.train.metrics_io import MetricsWriter, ThroughputMeter
+from tpu_resnet.train.schedule import build_schedule
+from tpu_resnet.train.state import TrainState, init_state, param_count
+from tpu_resnet.train.step import (
+    make_eval_step,
+    make_train_step,
+    shard_step,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "latest_step_in",
+    "train",
+    "MetricsWriter",
+    "ThroughputMeter",
+    "build_schedule",
+    "TrainState",
+    "init_state",
+    "param_count",
+    "make_eval_step",
+    "make_train_step",
+    "shard_step",
+]

@@ -1,0 +1,72 @@
+"""Orbax checkpointing — replaces MonitoredTrainingSession's Saver
+(reference resnet_cifar_train.py:330-342, ``save_checkpoint_steps=1000``)
+and the implicit resume-on-restart contract
+(resnet_imagenet_train.py:267-270).
+
+Only process 0 drives saves (the reference's chief / Horovod rank-0 rule,
+resnet_cifar_main.py:328) — orbax handles the multi-host coordination for
+sharded arrays itself. Consumers: the train loop (periodic save + resume),
+the polling evaluator (latest_step watching — the analog of
+``tf.train.get_checkpoint_state`` polling, resnet_cifar_eval.py:102), the
+export path and the inspector tool.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 5):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                create=True,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state, force: bool = False) -> bool:
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
+    def restore(self, state_template, step: Optional[int] = None):
+        """Restore into the structure/shardings of ``state_template``."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          state_template)
+        return self._mgr.restore(step,
+                                 args=ocp.args.StandardRestore(abstract))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def latest_step_in(directory: str) -> Optional[int]:
+    """Cheap latest-checkpoint probe for pollers (the eval sidecar's analog
+    of ``tf.train.get_checkpoint_state``, resnet_cifar_eval.py:102)."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = ocp.utils.checkpoint_steps(directory)
+    return max(steps) if steps else None

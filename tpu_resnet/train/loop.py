@@ -1,0 +1,125 @@
+"""The training loop — the replacement for every reference trainer's
+``while not mon_sess.should_stop(): mon_sess.run(train_op)``
+(reference resnet_cifar_train.py:343-344) plus its hook stack:
+
+- logging every ``log_every`` steps (LoggingTensorHook,
+  resnet_cifar_train.py:282-287),
+- metrics/summaries every ``summary_every`` steps (SummarySaverHook, :275-280),
+- checkpoint every ``checkpoint_every`` steps (save_checkpoint_steps=1000,
+  :335) with automatic resume from the latest checkpoint on restart
+  (MonitoredTrainingSession contract, resnet_imagenet_train.py:267-270),
+- stop at ``train_steps`` (StopAtStepHook, :289).
+
+One function serves every execution mode of the reference (single, PS-sync,
+async-PS, Horovod — SURVEY.md §2.3): the mesh decides the distribution.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_resnet import parallel
+from tpu_resnet.config import RunConfig
+from tpu_resnet.data import augment as aug_lib
+from tpu_resnet.data import cifar as cifar_data
+from tpu_resnet.data import pipeline
+from tpu_resnet.models import build_model
+from tpu_resnet.train import schedule as sched_lib
+from tpu_resnet.train.checkpoint import CheckpointManager
+from tpu_resnet.train.metrics_io import MetricsWriter, ThroughputMeter
+from tpu_resnet.train.state import init_state, param_count
+from tpu_resnet.train.step import make_train_step, shard_step
+
+log = logging.getLogger("tpu_resnet")
+
+
+def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
+    """Host pipeline: per-process shard → background batcher → device
+    prefetch queue."""
+    images, labels = cifar_data.load_split(cfg.data, train=True)
+    local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
+    batcher = pipeline.ShardedBatcher(images, labels, local_bs,
+                                      seed=cfg.train.seed,
+                                      start_step=start_step)
+    host_iter = pipeline.BackgroundIterator(iter(batcher),
+                                            capacity=cfg.data.prefetch + 2)
+    return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
+                                    depth=cfg.data.prefetch)
+
+
+def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
+          max_steps: Optional[int] = None):
+    """Run training to ``cfg.train.train_steps``; returns the final state."""
+    if mesh is None:
+        mesh = parallel.create_mesh(cfg.mesh)
+    parallel.check_divisible(cfg.train.global_batch_size, mesh)
+
+    model = build_model(cfg)
+    schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
+    augment_fn, _ = aug_lib.get_augment_fns(cfg.data.dataset)
+
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    init_rng, step_rng = jax.random.split(rng)
+    size = cfg.data.resolved_image_size
+    sample = jnp.zeros((1, size, size, 3), jnp.float32)
+    with jax.default_device(jax.devices()[0]):
+        state = init_state(model, cfg.optim, schedule, init_rng, sample)
+    # Replicate state across the mesh.
+    state = jax.device_put(state, parallel.replicated(mesh))
+    n_params = param_count(state.params)
+
+    ckpt = CheckpointManager(cfg.train.train_dir, keep=cfg.train.keep_checkpoints)
+    latest = ckpt.latest_step()
+    if latest is not None:
+        state = ckpt.restore(state)
+        log.info("resumed from step %d in %s", latest, cfg.train.train_dir)
+
+    if metrics is None:
+        metrics = MetricsWriter(cfg.train.train_dir,
+                                enabled=parallel.is_primary())
+
+    train_step = shard_step(
+        make_train_step(model, cfg.optim, schedule, cfg.data.num_classes,
+                        augment_fn, base_rng=step_rng), mesh)
+
+    step = int(jax.device_get(state.step))
+    data_iter = build_train_iterator(cfg, mesh, start_step=step)
+    total = max_steps if max_steps is not None else cfg.train.train_steps
+    meter = ThroughputMeter(cfg.train.global_batch_size)
+    log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
+             "global batch %d", cfg.model.name, cfg.data.dataset, total,
+             n_params / 1e6, dict(mesh.shape), cfg.train.global_batch_size)
+
+    meter.rate(step)
+    last_summary = step
+    while step < total:
+        images, labels = next(data_iter)
+        state, m = train_step(state, images, labels)
+        step += 1
+
+        if step % cfg.train.log_every == 0 or step == total:
+            m = {k: float(v) for k, v in jax.device_get(m).items()}
+            rate = meter.rate(step)
+            if rate:
+                m.update(rate)
+            log.info("step %d | loss %.4f | precision %.4f | lr %.4g%s",
+                     step, m["loss"], m["precision"], m["learning_rate"],
+                     f" | {m['steps_per_sec']:.2f} st/s "
+                     f"({m['images_per_sec']:.0f} img/s)" if rate else "")
+            # Summaries reuse the logged measurement, tagged with the step it
+            # was measured at (never a stale value under a different step).
+            if step - last_summary >= cfg.train.summary_every or step == total:
+                metrics.write(step, m)
+                last_summary = step
+        if step % cfg.train.checkpoint_every == 0 or step == total:
+            ckpt.save(step, state)
+
+    ckpt.wait()
+    metrics.close()
+    return state

@@ -1,0 +1,84 @@
+"""Metrics/observability — the reference's three channels
+(SURVEY.md §5: TensorBoard summaries, console LoggingTensorHook, per-task
+log files) rebuilt as one writer:
+
+- console lines every ``log_every`` steps with step/loss/precision/lr and
+  measured steps/sec + images/sec (reference resnet_cifar_train.py:282-287
+  derived throughput from LoggingTensorHook timestamps),
+- append-only ``metrics.jsonl`` scalars (machine-readable superset of the
+  summary-file channel, resnet_cifar_train.py:275-280),
+- optional TensorBoard event files when TF is importable (kept out of the
+  import path — the framework does not depend on TF).
+
+Only process 0 writes (chief-only summary hook, resnet_cifar_train.py:337).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+log = logging.getLogger("tpu_resnet")
+
+
+class MetricsWriter:
+    def __init__(self, directory: str, enabled: bool = True,
+                 tensorboard: bool = True):
+        self.enabled = enabled
+        self.directory = directory
+        self._jsonl = None
+        self._tb = None
+        if not enabled:
+            return
+        os.makedirs(directory, exist_ok=True)
+        self._jsonl = open(os.path.join(directory, "metrics.jsonl"), "a",
+                           buffering=1)
+        if tensorboard:
+            try:
+                from tensorflow.summary import (  # type: ignore
+                    create_file_writer)
+                self._tb = create_file_writer(directory)
+            except Exception:
+                self._tb = None
+
+    def write(self, step: int, scalars: Dict[str, float]) -> None:
+        if not self.enabled:
+            return
+        rec = {"step": int(step), "wall": time.time()}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._jsonl.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            import tensorflow as tf  # type: ignore
+            with self._tb.as_default():
+                for k, v in scalars.items():
+                    tf.summary.scalar(k, float(v), step=int(step))
+                self._tb.flush()
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+class ThroughputMeter:
+    """steps/sec + images/sec between log points."""
+
+    def __init__(self, global_batch: int):
+        self.global_batch = global_batch
+        self._t = time.perf_counter()
+        self._step = None
+
+    def rate(self, step: int) -> Optional[Dict[str, float]]:
+        now = time.perf_counter()
+        out = None
+        if self._step is not None and step > self._step and now > self._t:
+            sps = (step - self._step) / (now - self._t)
+            out = {"steps_per_sec": sps,
+                   "images_per_sec": sps * self.global_batch}
+        self._t = now
+        self._step = step
+        return out

@@ -1,0 +1,107 @@
+"""Learning-rate schedules as pure functions of the global step.
+
+The reference mutates the LR by rewriting a feed_dict inside a session hook
+(reference resnet_cifar_train.py:291-311; warmup variant
+resnet_imagenet_train.py:236-260) — impossible under jit. Here every schedule
+is a jit-traceable ``step -> lr`` function, so the LR lives inside the
+compiled train step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def piecewise_constant(boundaries: Sequence[int],
+                       values: Sequence[float]) -> Schedule:
+    """lr = values[i] for boundaries[i-1] <= step < boundaries[i]."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("need len(values) == len(boundaries) + 1")
+    b = jnp.asarray(boundaries, jnp.int32)
+    v = jnp.asarray(values, jnp.float32)
+
+    def schedule(step):
+        idx = jnp.sum(step >= b)
+        return v[idx]
+
+    return schedule
+
+
+def cifar_piecewise(base_lr: float = 0.1) -> Schedule:
+    """0.1 → 0.01 → 0.001 → 0.0001 at steps 40k/60k/80k
+    (reference resnet_cifar_train.py:302-311, resnet_single.py:84-104)."""
+    scale = base_lr / 0.1
+    return piecewise_constant(
+        (40_000, 60_000, 80_000),
+        tuple(scale * x for x in (0.1, 0.01, 0.001, 0.0001)))
+
+
+def imagenet_warmup(warmup_steps: int = 6240,
+                    warmup_init_lr: float = 0.1,
+                    peak_lr: float = 0.4,
+                    boundaries: Sequence[int] = (37_440, 74_880, 99_840)) -> Schedule:
+    """Intel-Caffe 8-node recipe: linear warmup 0.1→0.4 over 6240 steps, then
+    0.4 / 0.04 / 0.004 / 0.0004 at 37440/74880/99840
+    (reference resnet_imagenet_train.py:236-260, README.md:39-40)."""
+    b = jnp.asarray(boundaries, jnp.int32)
+    v = jnp.asarray([peak_lr, peak_lr * 0.1, peak_lr * 0.01, peak_lr * 0.001],
+                    jnp.float32)
+
+    def schedule(step):
+        frac = jnp.minimum(step, warmup_steps) / max(warmup_steps, 1)
+        warm = warmup_init_lr + (peak_lr - warmup_init_lr) * frac
+        idx = jnp.sum(step >= b)
+        return jnp.where(step < warmup_steps, warm, v[idx])
+
+    return schedule
+
+
+def constant(lr: float) -> Schedule:
+    def schedule(step):
+        del step
+        return jnp.float32(lr)
+
+    return schedule
+
+
+def cosine(base_lr: float, total_steps: int, warmup_steps: int = 0,
+           final_frac: float = 0.0) -> Schedule:
+    """Linear warmup then cosine decay — not in the reference; provided as the
+    modern default for TPU-scale runs."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * step / max(warmup_steps, 1)
+        progress = (step - warmup_steps) / max(total_steps - warmup_steps, 1)
+        progress = jnp.clip(progress, 0.0, 1.0)
+        cos = final_frac + (1 - final_frac) * 0.5 * (
+            1 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, base_lr * cos)
+
+    return schedule
+
+
+def build_schedule(optim_cfg, train_cfg) -> Schedule:
+    """Build from OptimConfig (+ TrainConfig for totals)."""
+    name = optim_cfg.schedule
+    if name == "cifar_piecewise":
+        if optim_cfg.boundaries:
+            return piecewise_constant(optim_cfg.boundaries, optim_cfg.values)
+        return cifar_piecewise(optim_cfg.base_lr)
+    if name == "imagenet_warmup":
+        kwargs = {}
+        if optim_cfg.boundaries:
+            kwargs["boundaries"] = optim_cfg.boundaries
+        return imagenet_warmup(optim_cfg.warmup_steps,
+                               optim_cfg.warmup_init_lr,
+                               peak_lr=optim_cfg.base_lr * 4, **kwargs)
+    if name == "constant":
+        return constant(optim_cfg.base_lr)
+    if name == "cosine":
+        return cosine(optim_cfg.base_lr, train_cfg.train_steps,
+                      optim_cfg.warmup_steps)
+    raise ValueError(f"unknown schedule {name!r}")

@@ -1,0 +1,54 @@
+"""Functional train state — replaces the reference's graph collections,
+global_step variable and session hooks (reference resnet_model.py:45-67,
+resnet_cifar_train.py:275-311) with one immutable pytree."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # int32 scalar — the reference's global_step
+    params: Any
+    batch_stats: Any           # BN moving mean/var (fp32)
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, batch_stats, tx: optax.GradientTransformation):
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   batch_stats=batch_stats, opt_state=tx.init(params))
+
+
+def build_optimizer(optim_cfg, schedule) -> optax.GradientTransformation:
+    """sgd / momentum(0.9) per reference resnet_model.py:96-99.
+
+    Weight decay is *not* here — the reference adds L2 to the loss over all
+    trainable variables (resnet_model.py:85-86), which interacts with
+    momentum differently than decoupled decay; the train step reproduces
+    that. The LR schedule is folded into the transformation as a pure
+    function of the optimizer step.
+    """
+    if optim_cfg.optimizer == "sgd":
+        return optax.sgd(schedule)
+    if optim_cfg.optimizer == "momentum":
+        return optax.sgd(schedule, momentum=optim_cfg.momentum)
+    raise ValueError(f"unknown optimizer {optim_cfg.optimizer!r}")
+
+
+def init_state(model, optim_cfg, schedule, rng: jax.Array,
+               sample_batch: jnp.ndarray) -> TrainState:
+    variables = model.init(rng, sample_batch, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = build_optimizer(optim_cfg, schedule)
+    return TrainState.create(params, batch_stats, tx)
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))

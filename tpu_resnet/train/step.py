@@ -1,0 +1,150 @@
+"""Compiled train/eval steps over a device mesh.
+
+This module is where the reference's entire distribution machinery —
+``SyncReplicasOptimizer`` gradient accumulation over gRPC parameter servers
+(reference resnet_model.py:102-113) and Horovod's NCCL allreduce
+(resnet_model.py:115-117) — collapses into *one* jitted SPMD function: the
+batch is sharded over the mesh's ``data`` axis, parameters are replicated,
+and XLA inserts the ICI all-reduces that the sharding math requires. The
+same compiled function is the single-device program when the mesh has one
+device (reference serial branch, resnet_cifar_train.py:313-326).
+
+Step semantics (reference file:line):
+- loss = softmax cross-entropy on one-hot labels (resnet_model.py:76-80)
+  + weight_decay * Σ l2_loss(w) over trainable variables
+  (resnet_model.py:85-86; tf.nn.l2_loss = sum(w²)/2).
+- BN statistics update inside the step — the analog of running update_ops as
+  control deps of minimize (resnet_model.py:120-122). Under global-batch jit
+  semantics BN moments are computed over the *global* batch (synced BN);
+  the reference's per-replica BN is the shard_map variant.
+- LR is a pure function of step (schedule.py) evaluated inside the step;
+  exposed in metrics like the reference's learning_rate summary
+  (resnet_model.py:92-93).
+- Train-precision metric from argmax(logits) == label
+  (resnet_cifar_train.py:271-273).
+- Augmentation runs on-device at the top of the step with a per-step RNG
+  derived from fold_in(base, step) — deterministic on resume.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_resnet.train.state import TrainState, build_optimizer
+
+
+def softmax_xent(logits: jnp.ndarray, labels: jnp.ndarray,
+                 num_classes: int, label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean softmax cross-entropy on integer labels (one-hot inside, per
+    reference resnet_model.py:76-80 / cifar_input.py:104-108)."""
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
+    if label_smoothing:
+        onehot = (onehot * (1 - label_smoothing)
+                  + label_smoothing / num_classes)
+    return optax.softmax_cross_entropy(logits, onehot).mean()
+
+
+def l2_weight_penalty(params, include_bn: bool) -> jnp.ndarray:
+    """weight_decay · Σ sum(w²)/2 over trainable vars
+    (reference resnet_model.py:85-86). ``include_bn=False`` drops the 1-D
+    scale/bias leaves (the modern variant)."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(params):
+        if not include_bn and leaf.ndim <= 1:
+            continue
+        total += jnp.sum(jnp.square(leaf.astype(jnp.float32))) / 2
+    return total
+
+
+def make_train_step(model, optim_cfg, schedule, num_classes: int,
+                    augment_fn: Optional[Callable] = None,
+                    base_rng: Optional[jax.Array] = None):
+    """Returns ``train_step(state, images, labels) -> (state, metrics)``.
+
+    ``images`` may be raw uint8 (augment_fn applied on device) or
+    pre-processed floats (augment_fn=None).
+    """
+    tx = build_optimizer(optim_cfg, schedule)
+    if base_rng is None:
+        base_rng = jax.random.PRNGKey(0)
+
+    def train_step(state: TrainState, images, labels):
+        rng = jax.random.fold_in(base_rng, state.step)
+        if augment_fn is not None:
+            images = augment_fn(rng, images)
+
+        def loss_fn(params):
+            logits, new_model_state = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            xent = softmax_xent(logits.astype(jnp.float32), labels,
+                                num_classes, optim_cfg.label_smoothing)
+            penalty = optim_cfg.weight_decay * l2_weight_penalty(
+                params, optim_cfg.weight_decay_on_bn)
+            return xent + penalty, (logits, new_model_state)
+
+        (loss, (logits, new_model_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_model_state["batch_stats"],
+            opt_state=new_opt_state,
+        )
+        precision = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        metrics = {
+            "loss": loss,
+            "precision": precision,
+            "learning_rate": schedule(state.step),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    return train_step
+
+
+def make_eval_step(model, num_classes: int,
+                   preprocess_fn: Optional[Callable] = None):
+    """``eval_step(state, images, labels) -> (correct_count, loss_sum,
+    valid_count)``; labels < 0 are padding (pipeline.eval_batches)."""
+
+    def eval_step(state: TrainState, images, labels):
+        if preprocess_fn is not None:
+            images = preprocess_fn(images)
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        valid = labels >= 0
+        safe_labels = jnp.maximum(labels, 0)
+        onehot = jax.nn.one_hot(safe_labels, num_classes,
+                                dtype=logits.dtype)
+        per_ex = optax.softmax_cross_entropy(logits, onehot)
+        correct = (jnp.argmax(logits, axis=-1) == safe_labels) & valid
+        return (jnp.sum(correct.astype(jnp.int32)),
+                jnp.sum(per_ex * valid.astype(per_ex.dtype)),
+                jnp.sum(valid.astype(jnp.int32)))
+
+    return eval_step
+
+
+def shard_step(step_fn, mesh: Mesh, donate_state: bool = True):
+    """Compile a step for the mesh: batch split over 'data', state
+    replicated. XLA emits the gradient/BN all-reduces over ICI — the entire
+    replacement for ps push/pull + Horovod fusion threads."""
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data, data),
+        donate_argnums=(0,) if donate_state else (),
+    )
