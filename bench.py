@@ -1,0 +1,85 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric: ResNet-50 CIFAR-10 training steps/sec at global batch 128
+on the available chips — directly comparable to the reference's published
+'local' number: 13.94 steps/s, README.md:28 (BASELINE.md row 1), which is
+``vs_baseline``'s denominator.
+
+The measured step is the full training step: on-device augmentation
+(pad/crop/flip/standardize), bf16 forward/backward, L2-in-loss, momentum
+update, BN stats update — i.e. what the reference's
+``mon_sess.run(train_op)`` covered (resnet_cifar_train.py:343-344), input
+pipeline included (synthetic CIFAR-shaped data so the benchmark needs no
+dataset download; the host pipeline path is identical).
+"""
+
+import json
+import time
+
+BASELINE_STEPS_PER_SEC = 13.94  # reference README.md:28
+
+
+def main():
+    import jax
+
+    from tpu_resnet.config import load_config
+    from tpu_resnet import parallel
+    from tpu_resnet.data import cifar as cifar_data
+    from tpu_resnet.data import pipeline
+    from tpu_resnet.data.augment import get_augment_fns
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import build_schedule, init_state
+    from tpu_resnet.train.step import make_train_step, shard_step
+    import jax.numpy as jnp
+
+    cfg = load_config("cifar10")
+    cfg.data.dataset = "synthetic"
+    cfg.data.train_examples  # synthetic: 1024 examples
+    cfg.train.global_batch_size = 128
+    cfg.model.resnet_size = 50
+    cfg.model.compute_dtype = "bfloat16"
+
+    mesh = parallel.create_mesh(cfg.mesh)
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    rng = jax.random.PRNGKey(0)
+    state = init_state(model, cfg.optim, sched, rng,
+                       jnp.zeros((1, 32, 32, 3)))
+    state = jax.device_put(state, parallel.replicated(mesh))
+
+    augment_fn, _ = get_augment_fns("cifar10")
+    step_fn = shard_step(
+        make_train_step(model, cfg.optim, sched, 10, augment_fn,
+                        base_rng=rng), mesh)
+
+    images, labels = cifar_data.synthetic_data(1024, 32, 10)
+    local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
+    batcher = pipeline.ShardedBatcher(images, labels, local_bs, seed=0)
+    it = pipeline.device_prefetch(
+        pipeline.BackgroundIterator(iter(batcher)),
+        parallel.batch_sharding(mesh))
+
+    warmup, measure = 20, 200
+    for _ in range(warmup):
+        img, lab = next(it)
+        state, metrics = step_fn(state, img, lab)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(measure):
+        img, lab = next(it)
+        state, metrics = step_fn(state, img, lab)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    sps = measure / dt
+    print(json.dumps({
+        "metric": "cifar10_resnet50_train_steps_per_sec_b128",
+        "value": round(sps, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

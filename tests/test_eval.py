@@ -37,11 +37,12 @@ def test_run_eval_pass_counts_every_example():
     state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
                        jnp.zeros((1, 32, 32, 3)))
     state = jax.device_put(state, replicated(mesh))
-    images, labels = synthetic_data(250, 32, 10, seed=5)
-    precision, loss = run_eval_pass(cfg, state, mesh, eval_step,
-                                    images, labels)
+    precision, loss, count = run_eval_pass(cfg, state, mesh, eval_step)
     assert 0.0 <= precision <= 1.0
     assert np.isfinite(loss)
+    # Every example of the synthetic eval split is counted exactly once
+    # (the reference sampled only half the CIFAR test set).
+    assert count == cfg.data.eval_examples
 
 
 def test_evaluate_once_end_to_end(tmp_path):

@@ -1,0 +1,96 @@
+"""ImageNet pipeline tests on tiny generated JPEG shards
+(format per reference resnet_imagenet_train.py:105-158)."""
+
+import io
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image
+
+from tpu_resnet.data import imagenet, tfrecord
+
+
+def make_shards(tmp_path, n_shards=2, per_shard=6, train=True, size=(320, 280)):
+    rng = np.random.default_rng(0)
+    labels = []
+    for s in range(n_shards):
+        name = (f"train-{s:05d}-of-{n_shards:05d}" if train
+                else f"validation-{s:05d}-of-{n_shards:05d}")
+        records = []
+        for i in range(per_shard):
+            arr = rng.integers(0, 256, (size[1], size[0], 3), np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, "JPEG")
+            label = int(rng.integers(1, 1001))  # shards are 1-based
+            labels.append(label)
+            records.append(tfrecord.encode_example({
+                "image/encoded": [buf.getvalue()],
+                "image/class/label": [label],
+                "image/class/text": [b"dummy"],
+            }))
+        tfrecord.write_records(str(tmp_path / name), records)
+    return labels
+
+
+def test_shard_files_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        imagenet.shard_files(str(tmp_path), train=True)
+
+
+def test_train_iterator_batches(tmp_path):
+    make_shards(tmp_path, train=True)
+    it = iter(imagenet.ImageNetIterator(str(tmp_path), local_batch=4,
+                                        train=True, num_workers=2,
+                                        shuffle_buffer=8))
+    images, labels = next(it)
+    assert images.shape == (4, 224, 224, 3)
+    assert images.dtype == np.uint8
+    assert labels.dtype == np.int32
+    assert (labels >= 0).all() and (labels < 1000).all()  # 0-based output
+
+
+def test_eval_examples_full_coverage_and_padding(tmp_path):
+    want = make_shards(tmp_path, train=False, n_shards=2, per_shard=5)
+    batches = list(imagenet.eval_examples(str(tmp_path), batch=4,
+                                          num_workers=1))
+    assert len(batches) == 3  # 10 examples → 4+4+2(+2 pad)
+    labels = np.concatenate([lab for _, lab in batches])
+    valid = labels[labels >= 0]
+    assert len(valid) == 10
+    # 0-based labels match the 1-based shard labels
+    assert sorted(valid.tolist()) == sorted(l - 1 for l in want)
+    assert (labels[-2:] == -1).all()
+
+
+def test_decode_and_crop_train_and_eval():
+    rng = np.random.default_rng(0)
+    arr = np.zeros((300, 400, 3), np.uint8)
+    arr[:, :, 0] = 255  # red image survives resize/crop
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=95)
+    out = imagenet.decode_and_crop(buf.getvalue(), True, rng,
+                                   resize_min=256, resize_max=320)
+    assert out.shape == (224, 224, 3)
+    assert out[:, :, 0].mean() > 200
+    out_eval = imagenet.decode_and_crop(buf.getvalue(), False, rng)
+    assert out_eval.shape == (224, 224, 3)
+
+
+def test_grayscale_jpeg_converted_to_rgb():
+    rng = np.random.default_rng(0)
+    buf = io.BytesIO()
+    Image.fromarray(np.full((260, 260), 128, np.uint8), "L").save(buf, "JPEG")
+    out = imagenet.decode_and_crop(buf.getvalue(), False, rng)
+    assert out.shape == (224, 224, 3)
+
+
+def test_files_striped_across_processes(tmp_path):
+    make_shards(tmp_path, n_shards=4, per_shard=2, train=True)
+    a = imagenet.ImageNetIterator(str(tmp_path), 2, process_index=0,
+                                  process_count=2)
+    b = imagenet.ImageNetIterator(str(tmp_path), 2, process_index=1,
+                                  process_count=2)
+    assert set(a.files).isdisjoint(b.files)
+    assert len(a.files) + len(b.files) == 4

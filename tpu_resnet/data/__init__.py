@@ -16,4 +16,39 @@ __all__ = [
     "ShardedBatcher",
     "device_prefetch",
     "eval_batches",
+    "train_batches",
+    "eval_split_batches",
 ]
+
+
+def train_batches(data_cfg, local_batch: int, seed: int = 0,
+                  start_step: int = 0):
+    """Per-dataset training batch iterator (host side, per-process shard),
+    yielding (uint8 images, int32 labels)."""
+    import jax
+
+    if data_cfg.dataset == "imagenet":
+        from tpu_resnet.data.imagenet import ImageNetIterator
+        return iter(ImageNetIterator(
+            data_cfg.data_dir, local_batch, train=True, seed=seed,
+            num_workers=data_cfg.num_workers,
+            shuffle_buffer=min(data_cfg.shuffle_buffer, 65536),
+            resize_min=data_cfg.resize_min, resize_max=data_cfg.resize_max,
+            start_step=start_step,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            image_size=data_cfg.resolved_image_size))
+    images, labels = load_split(data_cfg, train=True)
+    return iter(ShardedBatcher(images, labels, local_batch, seed=seed,
+                               start_step=start_step))
+
+
+def eval_split_batches(data_cfg, batch: int):
+    """Full eval-split pass; final batch zero-padded with labels=-1."""
+    if data_cfg.dataset == "imagenet":
+        from tpu_resnet.data.imagenet import eval_examples
+        return eval_examples(data_cfg.data_dir, batch,
+                             num_workers=data_cfg.num_workers,
+                             image_size=data_cfg.resolved_image_size)
+    images, labels = load_split(data_cfg, train=False)
+    return eval_batches(images, labels, batch)

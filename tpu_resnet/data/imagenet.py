@@ -1,0 +1,254 @@
+"""ImageNet input pipeline: TFRecord shards → decoded, cropped uint8 batches.
+
+Reference behavior being matched (file:line):
+- Shard naming: ``train-{00000..01023}-of-01024`` /
+  ``validation-{00000..00127}-of-00128`` under ``data_dir``
+  (resnet_imagenet_train.py:105-114).
+- Example keys: ``image/encoded`` (JPEG bytes), ``image/class/label``
+  (int64, 1-based → the dense layer has 1000(+1 background) classes; the
+  reference keeps labels as-is and uses 1000 one-hot with label-1? No — it
+  one-hots the raw label into 1000 classes after subtracting nothing;
+  Inception shards store 1..1000, the reference's ``tf.one_hot(label,
+  1000)`` silently maps 1000→all-zeros. We subtract 1 explicitly and
+  document the deviation — it fixes a real off-by-one in the reference
+  (resnet_imagenet_train.py:136-158).)
+- VGG preprocessing, host half (vgg_preprocessing.py): train =
+  aspect-preserving resize to a uniformly random smaller side in
+  [resize_min, resize_max] (:306-309) then random 224×224 crop (:284-314);
+  eval = resize to side 256 then central crop (:317-333). The flip and
+  mean-subtraction run on-device (tpu_resnet.data.augment).
+- Parallel decode: ``num_parallel_calls`` map threads
+  (resnet_imagenet_train.py:170-171) → a thread pool here (PIL releases
+  the GIL for JPEG decode).
+
+Unlike the reference — where every worker reads all 1024 shards and
+"shards" by independent shuffling (SURVEY.md §2.3) — shard files are
+striped across processes, and the per-epoch file order is a pure function
+of (seed, epoch).
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+import queue
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_resnet.data import tfrecord
+
+try:
+    from PIL import Image
+except ImportError:  # pragma: no cover - PIL is baked into the image
+    Image = None
+
+IMAGE_SIZE = 224
+EVAL_RESIZE = 256
+
+
+def read_shard_records(path: str, use_native: bool = True) -> Iterator[bytes]:
+    """Record payloads of one shard — native C++ splitter when built
+    (tpu_resnet/native), pure-python framing otherwise."""
+    if use_native:
+        try:
+            from tpu_resnet.native import available, loader
+            if available():
+                return iter(loader.tfrecord_payloads(path))
+        except Exception:
+            pass
+    return tfrecord.read_records(path)
+
+
+def shard_files(data_dir: str, train: bool) -> List[str]:
+    pattern = os.path.join(data_dir, "train-*" if train else "validation-*")
+    files = sorted(glob.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no ImageNet shards match {pattern}")
+    return files
+
+
+def parse_record(serialized: bytes) -> Tuple[bytes, int]:
+    ex = tfrecord.parse_example(serialized)
+    jpeg = ex["image/encoded"][0]
+    label = int(ex["image/class/label"][0])
+    return jpeg, label
+
+
+def _resize_keep_aspect(img: "Image.Image", smaller_side: int) -> "Image.Image":
+    w, h = img.size
+    scale = smaller_side / min(w, h)
+    return img.resize((max(1, round(w * scale)), max(1, round(h * scale))),
+                      Image.BILINEAR)
+
+
+def decode_and_crop(jpeg: bytes, train: bool, rng: np.random.Generator,
+                    resize_min: int = 256, resize_max: int = 512,
+                    eval_resize: int = EVAL_RESIZE,
+                    out_size: int = IMAGE_SIZE) -> np.ndarray:
+    """JPEG bytes → uint8 [out_size, out_size, 3] per VGG preprocessing
+    (host half; see module docstring)."""
+    img = Image.open(io.BytesIO(jpeg))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    if train:
+        side = int(rng.integers(resize_min, resize_max + 1))
+        img = _resize_keep_aspect(img, side)
+        w, h = img.size
+        x0 = int(rng.integers(0, w - out_size + 1))
+        y0 = int(rng.integers(0, h - out_size + 1))
+    else:
+        img = _resize_keep_aspect(img, eval_resize)
+        w, h = img.size
+        x0 = (w - out_size) // 2
+        y0 = (h - out_size) // 2
+    img = img.crop((x0, y0, x0 + out_size, y0 + out_size))
+    return np.asarray(img, np.uint8)
+
+
+class ImageNetIterator:
+    """Streaming train iterator: files striped per process, epoch-shuffled
+    record buffer, thread-pool JPEG decode, fixed-size uint8 batches."""
+
+    def __init__(self, data_dir: str, local_batch: int, *, train: bool = True,
+                 seed: int = 0, num_workers: int = 4,
+                 shuffle_buffer: int = 4096, resize_min: int = 256,
+                 resize_max: int = 512, start_step: int = 0,
+                 process_index: int = 0, process_count: int = 1,
+                 image_size: int = IMAGE_SIZE):
+        self.files = shard_files(data_dir, train)[process_index::process_count]
+        if not self.files:
+            raise ValueError("fewer shard files than processes")
+        self.local_batch = local_batch
+        self.train = train
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.shuffle_buffer = shuffle_buffer
+        self.resize_min = resize_min
+        self.resize_max = resize_max
+        self.image_size = image_size
+        self.start_step = start_step
+
+    def _records(self) -> Iterator[Tuple[bytes, int]]:
+        epoch = 0
+        while True:
+            files = list(self.files)
+            if self.train:
+                np.random.default_rng((self.seed, epoch)).shuffle(files)
+            for f in files:
+                for rec in read_shard_records(f):
+                    yield rec
+            if not self.train:
+                return
+            epoch += 1
+
+    def _shuffled_records(self) -> Iterator[bytes]:
+        """Reservoir-style shuffle buffer (the reference's
+        ``shuffle(buffer_size=1024)``, resnet_imagenet_train.py:174-178)."""
+        rng = np.random.default_rng((self.seed, 1))
+        buf: List[bytes] = []
+        for rec in self._records():
+            if not self.train:
+                yield rec
+                continue
+            buf.append(rec)
+            if len(buf) >= self.shuffle_buffer:
+                idx = int(rng.integers(0, len(buf)))
+                buf[idx], buf[-1] = buf[-1], buf[idx]
+                yield buf.pop()
+        while buf:
+            idx = int(rng.integers(0, len(buf)))
+            buf[idx], buf[-1] = buf[-1], buf[idx]
+            yield buf.pop()
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        if Image is None:
+            raise RuntimeError("PIL is required for ImageNet decoding")
+        rec_iter = self._shuffled_records()
+        lock = threading.Lock()
+        out_q: "queue.Queue" = queue.Queue(maxsize=4)
+        stop = threading.Event()
+
+        def worker(widx: int):
+            rng = np.random.default_rng((self.seed, widx, self.start_step))
+            images = np.empty((self.local_batch, self.image_size,
+                               self.image_size, 3), np.uint8)
+            labels = np.empty((self.local_batch,), np.int32)
+            # Each worker builds whole batches to avoid cross-thread
+            # assembly; batch order across workers is nondeterministic but
+            # contents are seed-stable per worker.
+            while not stop.is_set():
+                count = 0
+                while count < self.local_batch:
+                    with lock:
+                        try:
+                            rec = next(rec_iter)
+                        except StopIteration:
+                            rec = None
+                    if rec is None:
+                        break
+                    jpeg, label = parse_record(rec)
+                    images[count] = decode_and_crop(
+                        jpeg, self.train, rng,
+                        self.resize_min, self.resize_max,
+                        out_size=self.image_size)
+                    labels[count] = label - 1  # 1-based shard labels → 0-based
+                    count += 1
+                if count == self.local_batch:
+                    out_q.put((images.copy(), labels.copy()))
+                else:
+                    break
+            out_q.put(None)
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        finished = 0
+        try:
+            while finished < len(threads):
+                item = out_q.get()
+                if item is None:
+                    finished += 1
+                    continue
+                yield item
+        finally:
+            stop.set()
+            # drain so workers blocked on put() can exit
+            while not out_q.empty():
+                out_q.get_nowait()
+
+
+def eval_examples(data_dir: str, batch: int, *, num_workers: int = 4,
+                  process_index: int = 0, process_count: int = 1,
+                  image_size: int = IMAGE_SIZE
+                  ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Sequential eval pass with zero-padded final batch (labels=-1 mark
+    padding, mirroring pipeline.eval_batches)."""
+    it = ImageNetIterator(data_dir, batch, train=False,
+                          num_workers=num_workers,
+                          process_index=process_index,
+                          process_count=process_count,
+                          image_size=image_size)
+    rng = np.random.default_rng(0)
+    images = np.empty((batch, image_size, image_size, 3), np.uint8)
+    labels = np.full((batch,), -1, np.int32)
+    count = 0
+    if Image is None:
+        raise RuntimeError("PIL is required for ImageNet decoding")
+    for f in it.files:
+        for rec in read_shard_records(f):
+            jpeg, label = parse_record(rec)
+            images[count] = decode_and_crop(jpeg, False, rng,
+                                            out_size=image_size)
+            labels[count] = label - 1
+            count += 1
+            if count == batch:
+                yield images.copy(), labels.copy()
+                count = 0
+                labels[:] = -1
+    if count:
+        images[count:] = 0
+        yield images.copy(), labels.copy()

@@ -28,8 +28,6 @@ import numpy as np
 from tpu_resnet import parallel
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
-from tpu_resnet.data import cifar as cifar_data
-from tpu_resnet.data import pipeline
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
 from tpu_resnet.train.checkpoint import CheckpointManager, latest_step_in
@@ -49,21 +47,22 @@ def _mesh_eval_batch(cfg: RunConfig, mesh) -> int:
     return ((bs + n_data - 1) // n_data) * n_data
 
 
-def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn,
-                  images: np.ndarray, labels: np.ndarray
-                  ) -> Tuple[float, float]:
-    """One full pass over the eval split → (precision, mean_loss)."""
+def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn
+                  ) -> Tuple[float, float, int]:
+    """One full pass over the eval split → (precision, mean_loss, count)."""
+    import tpu_resnet.data as data_lib
+
     sharding = parallel.batch_sharding(mesh)
     correct = loss_sum = count = 0
-    for img, lab in pipeline.eval_batches(images, labels,
-                                          _mesh_eval_batch(cfg, mesh)):
+    for img, lab in data_lib.eval_split_batches(cfg.data,
+                                                _mesh_eval_batch(cfg, mesh)):
         gi = jax.device_put(img, sharding)
         gl = jax.device_put(lab, sharding)
         c, ls, n = eval_step_fn(state, gi, gl)
         correct += int(c)
         loss_sum += float(ls)
         count += int(n)
-    return correct / max(count, 1), loss_sum / max(count, 1)
+    return correct / max(count, 1), loss_sum / max(count, 1), count
 
 
 def build_eval_step(cfg: RunConfig, mesh):
@@ -89,7 +88,6 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
         mesh = parallel.create_mesh(cfg.mesh)
     model, eval_step_fn = build_eval_step(cfg, mesh)
     template = _template_state(cfg, model, mesh)
-    images, labels = cifar_data.load_split(cfg.data, train=False)
 
     eval_dir = os.path.join(cfg.train.train_dir, "eval")
     metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())
@@ -116,8 +114,8 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
         if step != last_seen:
             state = ckpt.restore(template, step=step)
             t0 = time.perf_counter()
-            precision, loss = run_eval_pass(cfg, state, mesh, eval_step_fn,
-                                            images, labels)
+            precision, loss, count = run_eval_pass(cfg, state, mesh,
+                                                   eval_step_fn)
             dt = time.perf_counter() - t0
             best = max(best, precision)
             if parallel.is_primary():
@@ -129,7 +127,7 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
                                  "eval_loss": loss})
             log.info("eval @ step %d: precision %.4f best %.4f loss %.4f "
                      "(%.1fs, %d examples)", step, precision, best, loss,
-                     dt, len(images))
+                     dt, count)
             last_seen = step
         if cfg.train.eval_once:
             break

@@ -1,0 +1,15 @@
+from tpu_resnet.export.serialize import (
+    InferenceBundle,
+    export_from_checkpoint,
+    load_inference,
+    make_inference_fn,
+    save_inference,
+)
+
+__all__ = [
+    "InferenceBundle",
+    "export_from_checkpoint",
+    "load_inference",
+    "make_inference_fn",
+    "save_inference",
+]

@@ -1,0 +1,132 @@
+"""Model export — the freeze_graph pipeline rebuilt for XLA.
+
+The reference freezes a checkpoint into a GraphDef ``.pb`` with named
+placeholder inputs and fetches (reference resnet_cifar_frozen_model.py:2-23:
+rebuild eval graph on placeholders → export_meta_graph → freeze_graph →
+load_graph + feed_dict), and serves it via feed-dict sessions
+(resnet_cifar_predict_from_pd.py:66-105).
+
+TPU-native equivalent: serialize the *compiled inference function* as
+StableHLO via ``jax.export`` (weights baked in as constants — the exact
+analog of freezing) next to a JSON manifest. The artifact is loadable
+without any model code, like a ``.pb``:
+
+    bundle = load_inference(path)
+    logits = bundle(images_uint8)   # preprocessing is baked into the graph
+
+Layout of an export directory:
+    manifest.json      model/config metadata
+    inference.stablehlo  serialized jax.export artifact
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from tpu_resnet.config import RunConfig
+from tpu_resnet.data import augment as aug_lib
+from tpu_resnet.models import build_model
+
+MANIFEST = "manifest.json"
+ARTIFACT = "inference.stablehlo"
+
+
+def make_inference_fn(cfg: RunConfig, params, batch_stats) -> Callable:
+    """Pure fn: uint8 [B,H,W,3] → logits [B,classes]; eval preprocessing
+    (standardization / mean subtraction) baked in, like the frozen graph's
+    in-graph preprocessing (resnet_cifar_frozen_model.py:81-88)."""
+    model = build_model(cfg)
+    _, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
+
+    def infer(images):
+        x = eval_pre(images)
+        return model.apply({"params": params, "batch_stats": batch_stats},
+                           x, train=False)
+
+    return infer
+
+
+def save_inference(cfg: RunConfig, params, batch_stats, out_dir: str,
+                   batch_size: int = 0) -> str:
+    """Freeze params into a serialized StableHLO artifact.
+
+    ``batch_size=0`` exports with a symbolic (polymorphic) batch dimension;
+    a fixed size pins it like the reference's placeholder shape.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    infer = make_inference_fn(cfg, params, batch_stats)
+    size = cfg.data.resolved_image_size
+    if batch_size:
+        arg = jax.ShapeDtypeStruct((batch_size, size, size, 3), jnp.uint8)
+    else:
+        (b,) = jax_export.symbolic_shape("b")
+        arg = jax.ShapeDtypeStruct((b, size, size, 3), jnp.uint8)
+    exported = jax_export.export(jax.jit(infer))(arg)
+    with open(os.path.join(out_dir, ARTIFACT), "wb") as f:
+        f.write(exported.serialize())
+    with open(os.path.join(out_dir, MANIFEST), "w") as f:
+        json.dump({
+            "format": "jax.export/stablehlo",
+            "model": cfg.model.name,
+            "resnet_size": cfg.model.resnet_size,
+            "dataset": cfg.data.dataset,
+            "num_classes": cfg.data.num_classes,
+            "image_size": size,
+            "batch_size": batch_size or "dynamic",
+            "input": "uint8 NHWC, raw pixels (preprocessing baked in)",
+            "output": "float32 logits",
+        }, f, indent=2)
+    return out_dir
+
+
+class InferenceBundle:
+    """Loaded frozen model (the load_graph+feed analog,
+    resnet_cifar_predict_from_pd.py:66-105)."""
+
+    def __init__(self, exported, manifest: dict):
+        self._exported = exported
+        self.manifest = manifest
+
+    def __call__(self, images: np.ndarray) -> np.ndarray:
+        return np.asarray(self._exported.call(jnp.asarray(images, jnp.uint8)))
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        return np.argmax(self(images), axis=-1)
+
+
+def load_inference(out_dir: str) -> InferenceBundle:
+    with open(os.path.join(out_dir, ARTIFACT), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(os.path.join(out_dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    return InferenceBundle(exported, manifest)
+
+
+def export_from_checkpoint(cfg: RunConfig, out_dir: str,
+                           step: int | None = None,
+                           batch_size: int = 0) -> str:
+    """checkpoint dir (cfg.train.train_dir) → frozen artifact — the 4-step
+    freeze recipe (resnet_cifar_frozen_model.py:2-23) as one call."""
+    from tpu_resnet import parallel
+    from tpu_resnet.train import build_schedule, init_state
+    from tpu_resnet.train.checkpoint import CheckpointManager
+
+    mesh = parallel.create_mesh(cfg.mesh)
+    model = build_model(cfg)
+    schedule = build_schedule(cfg.optim, cfg.train)
+    size = cfg.data.resolved_image_size
+    template = init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
+                          jnp.zeros((1, size, size, 3)))
+    template = jax.device_put(template, parallel.replicated(mesh))
+    ckpt = CheckpointManager(cfg.train.train_dir)
+    state = ckpt.restore(template, step=step)
+    return save_inference(cfg, jax.device_get(state.params),
+                          jax.device_get(state.batch_stats), out_dir,
+                          batch_size=batch_size)

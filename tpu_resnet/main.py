@@ -32,6 +32,9 @@ def main(argv=None):
         ("train", "run the training loop"),
         ("eval", "continuous checkpoint-polling evaluation (or --once)"),
         ("info", "print resolved config, param count and per-step FLOPs"),
+        ("export", "freeze a checkpoint into a serialized inference artifact"),
+        ("predict", "run a frozen artifact over the eval split"),
+        ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument("--preset", default="")
@@ -40,6 +43,23 @@ def main(argv=None):
         if name == "eval":
             p.add_argument("--once", action="store_true",
                            help="evaluate latest checkpoint once and exit")
+        if name == "export":
+            p.add_argument("--out", required=True,
+                           help="output directory for the frozen artifact")
+            p.add_argument("--step", type=int, default=None)
+            p.add_argument("--batch-size", type=int, default=0,
+                           help="0 = dynamic batch dimension")
+        if name == "predict":
+            p.add_argument("--export-dir", required=True)
+            p.add_argument("--out", default="/tmp/tpu_resnet_predict")
+            p.add_argument("--num-examples", type=int, default=256)
+            p.add_argument("--label-file", default="",
+                           help="imagenet idx→name map file")
+        if name == "inspect":
+            p.add_argument("--dir", required=True, help="train/ckpt dir")
+            p.add_argument("--step", type=int, default=None)
+            p.add_argument("--peek", default=None,
+                           help="print stats+head of one array by path")
     args = parser.parse_args(argv)
 
     from tpu_resnet.config import load_config
@@ -64,6 +84,25 @@ def main(argv=None):
     if args.command == "info":
         from tpu_resnet.tools.analysis import print_model_info
         print_model_info(cfg)
+        return 0
+
+    if args.command == "export":
+        from tpu_resnet.export import export_from_checkpoint
+        out = export_from_checkpoint(cfg, args.out, step=args.step,
+                                     batch_size=args.batch_size)
+        print(f"exported inference artifact to {out}")
+        return 0
+
+    if args.command == "predict":
+        from tpu_resnet.tools.predict import predict_from_export
+        predict_from_export(cfg, args.export_dir, args.out,
+                            num_examples=args.num_examples,
+                            label_file=args.label_file)
+        return 0
+
+    if args.command == "inspect":
+        from tpu_resnet.tools.inspect_ckpt import main as inspect_main
+        inspect_main(args.dir, step=args.step, peek=args.peek)
         return 0
 
     parser.error(f"unknown command {args.command}")

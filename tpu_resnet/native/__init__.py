@@ -1,0 +1,109 @@
+"""Native (C++) data-plane bindings via ctypes.
+
+Build once with ``python -m tpu_resnet.native.build`` (or let the launchers
+do it); every consumer falls back to the pure-numpy path when the shared
+library is absent, so the framework never *requires* a compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+import numpy as np
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "libtpuresnet_loader.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_SO_PATH):
+            raise ImportError(f"native loader not built ({_SO_PATH} missing); "
+                              "run: python -m tpu_resnet.native.build")
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.tr_crc32c.restype = ctypes.c_uint32
+        lib.tr_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.tr_file_size.restype = ctypes.c_int64
+        lib.tr_file_size.argtypes = [ctypes.c_char_p]
+        lib.tr_read_file.restype = ctypes.c_int64
+        lib.tr_read_file.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_int64]
+        lib.tr_read_files_concat.restype = ctypes.c_int64
+        lib.tr_read_files_concat.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+        lib.tr_tfrecord_split.restype = ctypes.c_int64
+        lib.tr_tfrecord_split.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return os.path.exists(_SO_PATH)
+
+
+class loader:
+    """Namespace matching the import sites (`from tpu_resnet.native import
+    loader`)."""
+
+    @staticmethod
+    def crc32c(data: bytes) -> int:
+        lib = _load()
+        buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+        return lib.tr_crc32c(buf, len(data))
+
+    @staticmethod
+    def read_fixed_length_records(files: List[str],
+                                  record_bytes: int) -> np.ndarray:
+        """Concurrent whole-file reads → uint8 [N, record_bytes]
+        (FixedLengthRecordReader role, reference cifar_input.py:58)."""
+        lib = _load()
+        sizes = [os.path.getsize(f) for f in files]
+        for f, s in zip(files, sizes):
+            if s % record_bytes:
+                raise ValueError(f"{f}: size {s} not a multiple of "
+                                 f"{record_bytes}")
+        total = sum(sizes)
+        out = np.empty(total, np.uint8)
+        c_paths = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        c_sizes = (ctypes.c_int64 * len(files))(*sizes)
+        rc = lib.tr_read_files_concat(
+            c_paths, c_sizes, len(files),
+            out.ctypes.data_as(ctypes.c_void_p),
+            min(8, len(files)))
+        if rc != 0:
+            raise IOError(f"native read failed for {files[-int(rc) - 1]}")
+        return out.reshape(-1, record_bytes)
+
+    @staticmethod
+    def tfrecord_payloads(path: str, verify_crc: bool = False):
+        """All record payloads of a TFRecord file as memoryviews over one
+        buffer (TFRecordDataset role)."""
+        lib = _load()
+        size = os.path.getsize(path)
+        buf = np.empty(size, np.uint8)
+        got = lib.tr_read_file(path.encode(),
+                               buf.ctypes.data_as(ctypes.c_void_p), size)
+        if got != size:
+            raise IOError(f"short read on {path}")
+        max_records = max(16, size // 24)
+        spans = np.empty(2 * max_records, np.int64)
+        n = lib.tr_tfrecord_split(
+            buf.ctypes.data_as(ctypes.c_void_p), size,
+            spans.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_records, 1 if verify_crc else 0)
+        if n == -1:
+            raise ValueError(f"{path}: corrupt TFRecord framing")
+        if n == -2:
+            raise ValueError(f"{path}: CRC mismatch")
+        if n < 0:
+            raise ValueError(f"{path}: split failed ({n})")
+        data = buf.tobytes()
+        return [data[spans[2 * i]:spans[2 * i] + spans[2 * i + 1]]
+                for i in range(int(n))]

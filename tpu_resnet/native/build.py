@@ -1,0 +1,30 @@
+"""Build the native loader: ``python -m tpu_resnet.native.build``."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "loader.cc")
+OUT = os.path.join(HERE, "libtpuresnet_loader.so")
+
+
+def build(force: bool = False) -> str:
+    if os.path.exists(OUT) and not force and (
+            os.path.getmtime(OUT) >= os.path.getmtime(SRC)):
+        return OUT
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        raise RuntimeError("no C++ compiler found")
+    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           SRC, "-o", OUT]
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    path = build(force="--force" in sys.argv)
+    print(f"built {path}")

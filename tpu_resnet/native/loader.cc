@@ -1,0 +1,156 @@
+// Native data-plane for tpu_resnet — the first-party replacement for the
+// role TF's C++ tf.data stack played in the reference (SURVEY.md §2.4):
+// FixedLengthRecordDataset (CIFAR bins, reference cifar_input.py:58) and
+// TFRecordDataset framing + CRC32C verification (ImageNet shards,
+// reference resnet_imagenet_train.py:169-183).
+//
+// Exposed as a C ABI consumed via ctypes (no pybind11 in the image).
+// Threaded file reads matter here: the host side of the input pipeline is
+// the one part of the framework where Python overhead is measurable.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------- CRC32C (sw)
+// Castagnoli polynomial, byte-table implementation; table generated at
+// first use. (Matches tpu_resnet/data/tfrecord.py crc32c.)
+uint32_t g_table[8][256];
+bool g_table_init = false;
+
+void init_table() {
+  if (g_table_init) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    g_table[0][i] = crc;
+  }
+  // Slice-by-8 tables for speed.
+  for (int t = 1; t < 8; t++) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = g_table[t - 1][i];
+      g_table[t][i] = (c >> 8) ^ g_table[0][c & 0xFF];
+    }
+  }
+  g_table_init = true;
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  init_table();
+  uint32_t crc = 0xFFFFFFFFu;
+  size_t i = 0;
+  // slice-by-8
+  for (; i + 8 <= n; i += 8) {
+    crc ^= (uint32_t)data[i] | ((uint32_t)data[i + 1] << 8) |
+           ((uint32_t)data[i + 2] << 16) | ((uint32_t)data[i + 3] << 24);
+    uint32_t hi = (uint32_t)data[i + 4] | ((uint32_t)data[i + 5] << 8) |
+                  ((uint32_t)data[i + 6] << 16) | ((uint32_t)data[i + 7] << 24);
+    crc = g_table[7][crc & 0xFF] ^ g_table[6][(crc >> 8) & 0xFF] ^
+          g_table[5][(crc >> 16) & 0xFF] ^ g_table[4][(crc >> 24) & 0xFF] ^
+          g_table[3][hi & 0xFF] ^ g_table[2][(hi >> 8) & 0xFF] ^
+          g_table[1][(hi >> 16) & 0xFF] ^ g_table[0][(hi >> 24) & 0xFF];
+  }
+  for (; i < n; i++) crc = (crc >> 8) ^ g_table[0][(crc ^ data[i]) & 0xFF];
+  return crc ^ 0xFFFFFFFFu;
+}
+
+inline uint32_t masked_crc(const uint8_t* data, size_t n) {
+  uint32_t c = crc32c(data, n);
+  return ((c >> 15) | (c << 17)) + 0xA282EAD8u;
+}
+
+int64_t file_size(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  int64_t n = std::ftell(f);
+  std::fclose(f);
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// crc32c of a buffer (exposed for tests / cross-checking).
+uint32_t tr_crc32c(const uint8_t* data, int64_t n) {
+  return crc32c(data, (size_t)n);
+}
+
+// Read one whole file into out (caller sized it via tr_file_size).
+// Returns bytes read or -1.
+int64_t tr_file_size(const char* path) { return file_size(path); }
+
+int64_t tr_read_file(const char* path, uint8_t* out, int64_t cap) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int64_t n = (int64_t)std::fread(out, 1, (size_t)cap, f);
+  std::fclose(f);
+  return n;
+}
+
+// Read many fixed-length-record files concurrently into one buffer laid
+// out back-to-back in argument order. sizes[i] must equal the file size.
+// Returns 0 on success, -(i+1) if file i failed.
+int64_t tr_read_files_concat(const char** paths, const int64_t* sizes,
+                             int64_t n_files, uint8_t* out,
+                             int64_t num_threads) {
+  std::vector<int64_t> offsets(n_files + 1, 0);
+  for (int64_t i = 0; i < n_files; i++)
+    offsets[i + 1] = offsets[i] + sizes[i];
+  std::vector<int64_t> status(n_files, 0);
+  int64_t nt = num_threads < 1 ? 1 : num_threads;
+  std::vector<std::thread> threads;
+  for (int64_t t = 0; t < nt; t++) {
+    threads.emplace_back([&, t]() {
+      for (int64_t i = t; i < n_files; i += nt) {
+        int64_t got = tr_read_file(paths[i], out + offsets[i], sizes[i]);
+        if (got != sizes[i]) status[i] = -(i + 1);
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int64_t i = 0; i < n_files; i++)
+    if (status[i]) return status[i];
+  return 0;
+}
+
+// Split a TFRecord file already loaded at `buf` into records.
+// Writes (offset, length) pairs into out_spans (capacity max_records).
+// verify: 0 = none, 1 = verify both CRCs.
+// Returns record count, or -1 on framing error, -2 on CRC mismatch,
+// -3 if more than max_records.
+int64_t tr_tfrecord_split(const uint8_t* buf, int64_t n, int64_t* out_spans,
+                          int64_t max_records, int32_t verify) {
+  int64_t pos = 0, count = 0;
+  while (pos < n) {
+    if (pos + 12 > n) return -1;
+    uint64_t len;
+    std::memcpy(&len, buf + pos, 8);  // little-endian hosts only (x86/arm)
+    if (verify) {
+      uint32_t want;
+      std::memcpy(&want, buf + pos + 8, 4);
+      if (masked_crc(buf + pos, 8) != want) return -2;
+    }
+    int64_t data_off = pos + 12;
+    if (data_off + (int64_t)len + 4 > n) return -1;
+    if (verify) {
+      uint32_t want;
+      std::memcpy(&want, buf + data_off + len, 4);
+      if (masked_crc(buf + data_off, len) != want) return -2;
+    }
+    if (count >= max_records) return -3;
+    out_spans[2 * count] = data_off;
+    out_spans[2 * count + 1] = (int64_t)len;
+    count++;
+    pos = data_off + (int64_t)len + 4;
+  }
+  return count;
+}
+
+}  // extern "C"

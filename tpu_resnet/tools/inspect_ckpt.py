@@ -1,0 +1,87 @@
+"""Checkpoint inspector — tf_saver.py parity (reference tf_saver.py:43-58
+lists every variable in a checkpoint via NewCheckpointReader; :131-135 peeks
+a tensor by name). Here against orbax checkpoints, with no model code needed.
+
+    python -m tpu_resnet inspect --dir /tmp/run [--step N] [--peek params/...]
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _flatten(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.extend(_flatten(tree[k], f"{prefix}/{k}" if prefix else k))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.extend(_flatten(v, f"{prefix}[{i}]"))
+    else:
+        out.append((prefix, tree))
+    return out
+
+
+def _item_path(train_dir: str, step: Optional[int]):
+    from tpu_resnet.train.checkpoint import latest_step_in
+
+    train_dir = os.path.abspath(train_dir)
+    if step is None:
+        step = latest_step_in(train_dir)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint in {train_dir}")
+    path = os.path.join(train_dir, str(step))
+    if os.path.isdir(os.path.join(path, "default")):
+        path = os.path.join(path, "default")  # CheckpointManager layout
+    return step, path
+
+
+def list_arrays(train_dir: str, step: Optional[int] = None):
+    """[(name, shape, dtype)] for every array in the checkpoint — no model
+    code or template needed (tf_saver's NewCheckpointReader role)."""
+    step, path = _item_path(train_dir, step)
+    meta = ocp.StandardCheckpointer().metadata(path)
+    tree = getattr(meta, "item_metadata", meta)
+    tree = getattr(tree, "tree", tree)  # TreeMetadata → raw dict
+    rows = []
+    for name, leaf in _flatten(tree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = getattr(leaf, "dtype", None)
+        rows.append((name, shape, str(dtype) if dtype is not None else "?"))
+    return step, rows
+
+
+def restore_raw(train_dir: str, step: Optional[int] = None):
+    """Full raw pytree (numpy), shardings dropped — for tooling/debug."""
+    step, path = _item_path(train_dir, step)
+    with ocp.PyTreeCheckpointer(restore_concurrent_gb=8) as ckptr:
+        tree = ckptr.restore(path)
+    return step, tree
+
+
+def main(train_dir: str, step: Optional[int] = None,
+         peek: Optional[str] = None):
+    step, rows = list_arrays(train_dir, step)
+    total = 0
+    print(f"checkpoint step {step} in {train_dir}: {len(rows)} arrays")
+    for name, shape, dtype in rows:
+        n = int(np.prod(shape)) if shape else 1
+        total += n
+        print(f"  {name:<70} {str(shape):<20} {dtype}")
+    print(f"total elements: {total:,}")
+    if peek:
+        _, tree = restore_raw(train_dir, step)
+        flat = dict(_flatten(tree))
+        if peek not in flat:
+            matches = [k for k in flat if peek in k]
+            raise KeyError(f"{peek!r} not found; close matches: {matches[:5]}")
+        arr = np.asarray(flat[peek])
+        print(f"\n{peek}: shape={arr.shape} dtype={arr.dtype} "
+              f"mean={arr.mean():.6g} std={arr.std():.6g}")
+        print(arr.ravel()[:16])

@@ -1,0 +1,109 @@
+"""Prediction / misprediction visualization — parity with the reference's
+predict tools (resnet_cifar_predict.py: restore ckpt → predict test batch →
+matplotlib grid with mispredictions highlighted in red :222-245;
+resnet_cifar_predict_from_pd.py: same from a frozen .pb; the ImageNet
+notebook maps indices → class names via
+data/imagenet1000_clsidx_to_labels.txt).
+
+Outputs: printed precision, ``predictions.json`` and a
+``mispredictions.png`` grid (red border = wrong) in --out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from tpu_resnet.config import RunConfig
+
+CIFAR10_LABELS = ["airplane", "automobile", "bird", "cat", "deer",
+                  "dog", "frog", "horse", "ship", "truck"]
+
+
+def load_label_map(cfg: RunConfig, label_file: str = "") -> list:
+    if label_file:
+        names = {}
+        with open(label_file) as f:
+            # imagenet1000_clsidx_to_labels.txt style: "idx: 'name',"
+            for line in f:
+                line = line.strip().rstrip(",")
+                if ":" in line:
+                    idx, name = line.split(":", 1)
+                    names[int(idx.strip(" {"))] = name.strip().strip("'\"")
+        return [names.get(i, str(i)) for i in range(cfg.data.num_classes)]
+    if cfg.data.dataset == "cifar10":
+        return CIFAR10_LABELS
+    return [str(i) for i in range(cfg.data.num_classes)]
+
+
+def misprediction_grid(images: np.ndarray, labels: np.ndarray,
+                       preds: np.ndarray, path: str, max_images: int = 64,
+                       label_names: Optional[list] = None) -> None:
+    """Save a PNG grid; mispredicted images get a red border (the
+    matplotlib-red-title analog, resnet_cifar_predict.py:236-245)."""
+    from PIL import Image
+
+    n = min(len(images), max_images)
+    cols = 8
+    rows = (n + cols - 1) // cols
+    cell = images.shape[1] + 6
+    canvas = np.full((rows * cell, cols * cell, 3), 255, np.uint8)
+    for i in range(n):
+        r, c = divmod(i, cols)
+        y, x = r * cell, c * cell
+        wrong = preds[i] != labels[i]
+        color = (220, 20, 20) if wrong else (20, 160, 20)
+        canvas[y:y + cell, x:x + cell] = color
+        canvas[y + 3:y + cell - 3, x + 3:x + cell - 3] = images[i]
+    Image.fromarray(canvas).save(path)
+
+
+def predict_from_export(cfg: RunConfig, export_dir: str, out_dir: str,
+                        num_examples: int = 256, label_file: str = ""):
+    """Frozen-artifact inference over the eval split
+    (resnet_cifar_predict_from_pd.py parity)."""
+    import tpu_resnet.data as data_lib
+    from tpu_resnet.export import load_inference
+
+    bundle = load_inference(export_dir)
+    names = load_label_map(cfg, label_file)
+    os.makedirs(out_dir, exist_ok=True)
+
+    all_images, all_labels, all_preds = [], [], []
+    seen = 0
+    for images, labels in data_lib.eval_split_batches(
+            cfg.data, min(64, num_examples)):
+        preds = bundle.predict(images)
+        valid = labels >= 0
+        all_images.append(images[valid])
+        all_labels.append(labels[valid])
+        all_preds.append(preds[valid])
+        seen += int(valid.sum())
+        if seen >= num_examples:
+            break
+    images = np.concatenate(all_images)[:num_examples]
+    labels = np.concatenate(all_labels)[:num_examples]
+    preds = np.concatenate(all_preds)[:num_examples]
+
+    precision = float((preds == labels).mean())
+    wrong = np.flatnonzero(preds != labels)
+    results = {
+        "precision": precision,
+        "num_examples": int(len(labels)),
+        "mispredicted": [
+            {"index": int(i), "label": names[labels[i]],
+             "pred": names[preds[i]]} for i in wrong[:100]
+        ],
+    }
+    with open(os.path.join(out_dir, "predictions.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    misprediction_grid(images, labels, preds,
+                       os.path.join(out_dir, "mispredictions.png"),
+                       label_names=names)
+    print(f"precision over {len(labels)} examples: {precision:.4f} "
+          f"({len(wrong)} mispredicted)")
+    print(f"wrote {out_dir}/predictions.json and mispredictions.png")
+    return precision

@@ -27,7 +27,6 @@ import numpy as np
 from tpu_resnet import parallel
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
-from tpu_resnet.data import cifar as cifar_data
 from tpu_resnet.data import pipeline
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
@@ -42,13 +41,13 @@ log = logging.getLogger("tpu_resnet")
 def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
     """Host pipeline: per-process shard → background batcher → device
     prefetch queue."""
-    images, labels = cifar_data.load_split(cfg.data, train=True)
+    import tpu_resnet.data as data_lib
+
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
-    batcher = pipeline.ShardedBatcher(images, labels, local_bs,
-                                      seed=cfg.train.seed,
-                                      start_step=start_step)
-    host_iter = pipeline.BackgroundIterator(iter(batcher),
-                                            capacity=cfg.data.prefetch + 2)
+    host_iter = pipeline.BackgroundIterator(
+        data_lib.train_batches(cfg.data, local_bs, seed=cfg.train.seed,
+                               start_step=start_step),
+        capacity=cfg.data.prefetch + 2)
     return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
                                     depth=cfg.data.prefetch)
 
