@@ -50,7 +50,7 @@ def main():
     augment_fn, _ = get_augment_fns("cifar10")
     step_fn = shard_step(
         make_train_step(model, cfg.optim, sched, 10, augment_fn,
-                        base_rng=rng), mesh)
+                        base_rng=rng, mesh=mesh), mesh)
 
     images, labels = cifar_data.synthetic_data(1024, 32, 10)
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Multi-PROCESS fake cluster on one machine: P processes × D virtual CPU
+# devices rendezvous via jax.distributed on a local port — the TPU-native
+# analog of the reference's localhost ps/worker cluster
+# (mkl-scripts/submit_mac_dist.sh: 1 ps + 2 workers on ports 2230/2220+).
+# Validates the real multi-host code path (coordinator rendezvous,
+# per-process input shards, cross-process all-reduce) with zero hardware.
+#
+#   ./launch/local_multiprocess.sh [P] [D] [extra overrides...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+P="${1:-2}"; shift || true
+D="${1:-4}"; shift || true
+PORT=$((20000 + RANDOM % 20000))
+LOGDIR="${LOGDIR:-/tmp/tpu_resnet/multiproc}"
+mkdir -p "$LOGDIR"
+
+pids=()
+for ((i = 0; i < P; i++)); do
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+  XLA_FLAGS="--xla_force_host_platform_device_count=${D}" \
+  TPU_COORDINATOR_ADDRESS="127.0.0.1:${PORT}" \
+  TPU_NUM_PROCESSES="$P" \
+  TPU_PROCESS_ID="$i" \
+  python -m tpu_resnet train --preset smoke \
+      train.train_dir="$LOGDIR/run" \
+      train.global_batch_size=$((P * D * 2)) \
+      "$@" > "$LOGDIR/proc.$i.log" 2>&1 &
+  pids+=($!)
+done
+echo "launched $P processes (logs: $LOGDIR/proc.*.log)"
+code=0
+for pid in "${pids[@]}"; do wait "$pid" || code=$?; done
+exit $code

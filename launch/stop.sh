@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Teardown — the reference's stop.sh / mkl-scripts/kill.sh equivalent,
+# scoped to this framework's processes instead of `kill -9` on all python.
+set -uo pipefail
+pkill -f "python -m tpu_resnet" 2>/dev/null
+pkill -f "tpu_resnet/main.py" 2>/dev/null
+echo "stopped tpu_resnet processes"

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Launch on a (multi-host) Cloud TPU VM slice: one process per host.
+#
+# Replaces BOTH reference bring-up stacks at once — the docker ps/worker
+# scripts (start-resnet-*-train.sh: one container per ps/worker task with
+# static IPs) and the mpirun/ssh Horovod mesh
+# (start-resnet-*-horovod-train.sh:119-140) — because on TPU the only
+# topology job left is "run the same program on every host":
+# jax.distributed.initialize auto-discovers coordinator/topology from the
+# TPU VM metadata, and XLA runs collectives over ICI.
+#
+#   ./launch/tpu_vm.sh <tpu-name> <zone> [--preset imagenet ...]
+set -euo pipefail
+
+TPU_NAME="$1"; shift
+ZONE="$1"; shift
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd \$(mktemp -d) && git clone ${REPO_URL:-<this-repo>} repo \
+             && cd repo && python -m tpu_resnet.native.build || true \
+             && python -m tpu_resnet train $*"

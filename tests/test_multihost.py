@@ -1,0 +1,101 @@
+"""Multi-process distributed-backend integration test.
+
+The reference's only multi-node test was a localhost fake cluster: N OS
+processes forming a real ps/worker cluster over local ports
+(mkl-scripts/submit_mac_dist.sh, SURVEY.md §4). This is the TPU-native
+analog: two OS processes rendezvous through ``jax.distributed.initialize``
+on 127.0.0.1, each owning 4 virtual CPU devices, and run real data-parallel
+training steps over the resulting 8-device global mesh — exercising the
+launcher env protocol (TPU_COORDINATOR_ADDRESS/TPU_NUM_PROCESSES/
+TPU_PROCESS_ID), per-process input sharding, global-batch assembly via
+``make_array_from_process_local_data``, and cross-process gradient
+all-reduce.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+WORKER = r"""
+import os, sys, json
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from tpu_resnet import parallel
+
+parallel.initialize()  # from TPU_* env vars (launcher protocol)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 4
+
+import jax.numpy as jnp
+import numpy as np
+from tpu_resnet.config import load_config
+from tpu_resnet.data import pipeline
+from tpu_resnet.data.cifar import synthetic_data
+from tpu_resnet.models import build_model
+from tpu_resnet.train import build_schedule, init_state
+from tpu_resnet.train.step import make_train_step, shard_step
+
+cfg = load_config("smoke")
+cfg.train.global_batch_size = 16
+mesh = parallel.create_mesh(cfg.mesh)
+model = build_model(cfg)
+sched = build_schedule(cfg.optim, cfg.train)
+state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3)))
+state = jax.device_put(state, parallel.replicated(mesh))
+step_fn = shard_step(
+    make_train_step(model, cfg.optim, sched, 10, augment_fn=None,
+                    base_rng=jax.random.PRNGKey(1)), mesh)
+
+images, labels = synthetic_data(64, 32, 10, seed=0)
+local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
+assert local_bs == 8
+batcher = pipeline.ShardedBatcher(images, labels.astype(np.int32), local_bs,
+                                  seed=0)
+it = pipeline.device_prefetch(iter(batcher), parallel.batch_sharding(mesh))
+for i in range(4):
+    gi, gl = next(it)
+    assert gi.shape[0] == 16  # global batch
+    state, metrics = step_fn(state, gi, gl)
+loss = float(jax.device_get(metrics["loss"]))
+print(json.dumps({"process": jax.process_index(), "loss": loss,
+                  "step": int(jax.device_get(state.step))}))
+"""
+
+
+def test_two_process_data_parallel(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{port.getsockname()[1]}"
+    port.close()
+
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # force CPU backend
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["TPU_COORDINATOR_ADDRESS"] = coord
+        env["TPU_NUM_PROCESSES"] = "2"
+        env["TPU_PROCESS_ID"] = str(pid)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=560)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out)
+
+    import json
+    results = [json.loads(o.strip().splitlines()[-1]) for o in outs]
+    assert {r["process"] for r in results} == {0, 1}
+    assert all(r["step"] == 4 for r in results)
+    # SPMD: both processes computed the identical global loss.
+    assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6

@@ -114,6 +114,9 @@ class OptimConfig:
     # modern no-decay-on-BN/bias variant.
     weight_decay_on_bn: bool = True
     label_smoothing: float = 0.0
+    # Fused Pallas softmax-xent kernel (tpu_resnet/ops) on TPU backends;
+    # falls back to the optax chain on CPU or when label_smoothing != 0.
+    use_pallas_xent: bool = True
     # warmup schedule knobs (imagenet_warmup)
     warmup_steps: int = 6240
     warmup_init_lr: float = 0.1

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for hot ops. Each op has an interpret-mode path so the
+same kernel code runs (slowly) on CPU in tests."""
+
+from tpu_resnet.ops.softmax_xent import (
+    softmax_xent_mean,
+    softmax_xent_per_example,
+)
+
+__all__ = ["softmax_xent_mean", "softmax_xent_per_example"]

@@ -1,0 +1,157 @@
+"""Fused softmax cross-entropy as a Pallas TPU kernel (forward + custom VJP).
+
+Replaces the ``softmax → log → one_hot multiply → reduce`` chain
+(reference resnet_model.py:76-80 via tf.losses.softmax_cross_entropy) with
+one VMEM-resident pass per batch tile:
+
+- forward: per-example ``logsumexp(logits) - logits[label]`` without
+  materializing the [B, C] one-hot or probability tensors in HBM,
+- backward: ``(softmax(logits) - onehot) * g`` recomputed in-kernel from the
+  saved logits (no probs residual).
+
+Integer labels ride along as a [B, 1] int32 VMEM block and the one-hot is
+formed on the fly with ``broadcasted_iota`` — the TPU-native counterpart of
+the reference's ``sparse_to_dense`` one-hot (cifar_input.py:104-108).
+
+The public entry ``softmax_xent_mean`` pads C up to a lane multiple (128)
+with -1e30 and B up to the batch tile, masking padded rows, so callers can
+use any (B, C). ``interpret=True`` (auto on non-TPU backends) runs the same
+kernel under the Pallas interpreter for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; absent on pure-CPU installs of older jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+_LANE = 128
+_NEG = -1e30
+
+
+def _is_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _block_spec(shape):
+    if _VMEM is None:
+        return pl.BlockSpec(shape, lambda i: (i, 0))
+    return pl.BlockSpec(shape, lambda i: (i, 0), memory_space=_VMEM)
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref):
+    x = logits_ref[:].astype(jnp.float32)          # [TB, C]
+    lab = labels_ref[:]                            # [TB, 1] int32
+    m = jnp.max(x, axis=1, keepdims=True)
+    lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=1, keepdims=True)) + m
+    classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    label_logit = jnp.sum(jnp.where(classes == lab, x, 0.0), axis=1,
+                          keepdims=True)
+    # Broadcast per-example loss across the lane dim; caller slices [:, 0].
+    loss_ref[:] = jnp.broadcast_to(lse - label_logit, x.shape)
+
+
+def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref):
+    x = logits_ref[:].astype(jnp.float32)
+    lab = labels_ref[:]
+    g = g_ref[:][:, :1]                            # [TB, 1]
+    m = jnp.max(x, axis=1, keepdims=True)
+    ex = jnp.exp(x - m)
+    probs = ex / jnp.sum(ex, axis=1, keepdims=True)
+    classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (classes == lab).astype(jnp.float32)
+    dx_ref[:] = ((probs - onehot) * g).astype(dx_ref.dtype)
+
+
+def _pallas_per_example(logits, labels, batch_tile, interpret):
+    b, c = logits.shape
+    grid = (b // batch_tile,)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[_block_spec((batch_tile, c)),
+                  _block_spec((batch_tile, 1))],
+        out_specs=_block_spec((batch_tile, c)),
+        out_shape=jax.ShapeDtypeStruct((b, c), jnp.float32),
+        interpret=interpret,
+    )(logits, labels)
+    return out[:, 0]
+
+
+def _pallas_bwd(logits, labels, g, batch_tile, interpret):
+    b, c = logits.shape
+    grid = (b // batch_tile,)
+    g2d = jnp.broadcast_to(g[:, None], (b, c)).astype(jnp.float32)
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[_block_spec((batch_tile, c)),
+                  _block_spec((batch_tile, 1)),
+                  _block_spec((batch_tile, c))],
+        out_specs=_block_spec((batch_tile, c)),
+        out_shape=jax.ShapeDtypeStruct((b, c), logits.dtype),
+        interpret=interpret,
+    )(logits, labels, g2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _xent_padded(logits, labels, batch_tile, interpret):
+    return _pallas_per_example(logits, labels, batch_tile, interpret)
+
+
+def _xent_padded_fwd(logits, labels, batch_tile, interpret):
+    loss = _pallas_per_example(logits, labels, batch_tile, interpret)
+    return loss, (logits, labels)
+
+
+def _xent_padded_bwd(batch_tile, interpret, residuals, g):
+    logits, labels = residuals
+    dx = _pallas_bwd(logits, labels, g, batch_tile, interpret)
+    return dx, None
+
+
+_xent_padded.defvjp(_xent_padded_fwd, _xent_padded_bwd)
+
+
+def softmax_xent_per_example(logits: jnp.ndarray, labels: jnp.ndarray,
+                             batch_tile: int = 128,
+                             interpret: bool | None = None) -> jnp.ndarray:
+    """Per-example softmax cross-entropy, differentiable w.r.t. logits.
+
+    logits [B, C] (any float dtype), labels [B] int. Internally pads C to a
+    multiple of 128 (with -1e30) and B to ``batch_tile`` (masked out).
+    """
+    if interpret is None:
+        interpret = not _is_tpu()
+    b, c = logits.shape
+    c_pad = (-c) % _LANE
+    b_tile = min(batch_tile, max(8, b))
+    b_pad = (-b) % b_tile
+    x = logits.astype(jnp.float32)
+    if c_pad:
+        x = jnp.pad(x, ((0, 0), (0, c_pad)), constant_values=_NEG)
+    if b_pad:
+        x = jnp.pad(x, ((0, b_pad), (0, 0)))
+    lab = jnp.pad(labels.astype(jnp.int32), (0, b_pad)).reshape(-1, 1)
+    loss = _xent_padded(x, lab, b_tile, interpret)
+    return loss[:b]
+
+
+def softmax_xent_mean(logits: jnp.ndarray, labels: jnp.ndarray,
+                      interpret: bool | None = None) -> jnp.ndarray:
+    """Mean loss over the batch — drop-in for the optax/one-hot chain in the
+    train step (tpu_resnet/train/step.py softmax_xent)."""
+    return jnp.mean(softmax_xent_per_example(logits, labels,
+                                             interpret=interpret))

@@ -67,7 +67,9 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     init_rng, step_rng = jax.random.split(rng)
     size = cfg.data.resolved_image_size
     sample = jnp.zeros((1, size, size, 3), jnp.float32)
-    with jax.default_device(jax.devices()[0]):
+    # Init on this process's first local device (jax.devices()[0] may be a
+    # non-addressable remote device on non-primary hosts).
+    with jax.default_device(jax.local_devices()[0]):
         state = init_state(model, cfg.optim, schedule, init_rng, sample)
     # Replicate state across the mesh.
     state = jax.device_put(state, parallel.replicated(mesh))
@@ -85,7 +87,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
 
     train_step = shard_step(
         make_train_step(model, cfg.optim, schedule, cfg.data.num_classes,
-                        augment_fn, base_rng=step_rng), mesh)
+                        augment_fn, base_rng=step_rng, mesh=mesh), mesh)
 
     step = int(jax.device_get(state.step))
     data_iter = build_train_iterator(cfg, mesh, start_step=step)

@@ -64,7 +64,8 @@ def l2_weight_penalty(params, include_bn: bool) -> jnp.ndarray:
 
 def make_train_step(model, optim_cfg, schedule, num_classes: int,
                     augment_fn: Optional[Callable] = None,
-                    base_rng: Optional[jax.Array] = None):
+                    base_rng: Optional[jax.Array] = None,
+                    mesh: Optional[Mesh] = None):
     """Returns ``train_step(state, images, labels) -> (state, metrics)``.
 
     ``images`` may be raw uint8 (augment_fn applied on device) or
@@ -73,6 +74,16 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
     tx = build_optimizer(optim_cfg, schedule)
     if base_rng is None:
         base_rng = jax.random.PRNGKey(0)
+
+    # Fused Pallas xent: used on TPU for single-device meshes (under a
+    # multi-device auto-sharded jit, a pallas_call has no partitioning rule,
+    # so there XLA's own softmax fusion stays in charge).
+    use_pallas = (getattr(optim_cfg, "use_pallas_xent", False)
+                  and optim_cfg.label_smoothing == 0.0
+                  and jax.default_backend() == "tpu"
+                  and (mesh is None or mesh.size == 1))
+    if use_pallas:
+        from tpu_resnet.ops import softmax_xent_mean as _pallas_xent
 
     def train_step(state: TrainState, images, labels):
         rng = jax.random.fold_in(base_rng, state.step)
@@ -83,8 +94,11 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
             logits, new_model_state = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
                 images, train=True, mutable=["batch_stats"])
-            xent = softmax_xent(logits.astype(jnp.float32), labels,
-                                num_classes, optim_cfg.label_smoothing)
+            if use_pallas:
+                xent = _pallas_xent(logits.astype(jnp.float32), labels)
+            else:
+                xent = softmax_xent(logits.astype(jnp.float32), labels,
+                                    num_classes, optim_cfg.label_smoothing)
             penalty = optim_cfg.weight_decay * l2_weight_penalty(
                 params, optim_cfg.weight_decay_on_bn)
             return xent + penalty, (logits, new_model_state)
