@@ -1,0 +1,157 @@
+"""Device-resident dataset path (tpu_resnet/data/device_data.py): epoch
+shuffle semantics, chunked-step equivalence to the one-dispatch-per-step
+loop, and loop integration — on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resnet.config import load_config
+from tpu_resnet.data import device_data
+from tpu_resnet.data.cifar import synthetic_data
+from tpu_resnet.models import build_model
+from tpu_resnet.parallel import create_mesh, replicated
+from tpu_resnet.train import build_schedule, init_state, make_train_step
+from tpu_resnet.train.loop import _chunk_len, train
+
+
+def _mesh(n=8):
+    cfg = load_config("smoke")
+    return create_mesh(cfg.mesh, devices=jax.devices()[:n])
+
+
+def test_epoch_buffer_covers_split_without_duplicates():
+    mesh = _mesh()
+    images = np.arange(64, dtype=np.uint8).reshape(64, 1, 1, 1).repeat(
+        4, axis=3)  # image i filled with value i
+    labels = np.arange(64, dtype=np.int64)
+    ds = device_data.DeviceDataset(mesh, images, labels, batch=16, seed=3)
+    assert ds.steps_per_epoch == 4
+    ds.ensure_epoch(0)
+    got = np.asarray(jax.device_get(ds.labels)).ravel()
+    assert sorted(got.tolist()) == list(range(64))  # exact cover, no dups
+    # images rows travel with their labels
+    imgs = np.asarray(jax.device_get(ds.images)).reshape(64, -1)
+    np.testing.assert_array_equal(imgs[:, 0], got)
+
+
+def test_epoch_shuffle_is_deterministic_and_varies_by_epoch():
+    mesh = _mesh()
+    images, labels = synthetic_data(128, 8, 10)
+    a = device_data.DeviceDataset(mesh, images, labels, batch=16, seed=7)
+    b = device_data.DeviceDataset(mesh, images, labels, batch=16, seed=7)
+    a.ensure_epoch(2)
+    b.ensure_epoch(2)
+    np.testing.assert_array_equal(jax.device_get(a.labels),
+                                  jax.device_get(b.labels))
+    b.ensure_epoch(3)
+    assert not np.array_equal(jax.device_get(a.labels),
+                              jax.device_get(b.labels))
+
+
+def test_chunked_equals_sequential_steps():
+    """k fused steps must be bit-for-bit the same computation as k single
+    dispatches (fp32 smoke model)."""
+    cfg = load_config("smoke")
+    cfg.train.global_batch_size = 16
+    mesh = _mesh()
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    base = make_train_step(model, cfg.optim, sched, cfg.data.num_classes,
+                           augment_fn=None, base_rng=jax.random.PRNGKey(1))
+    images, labels = synthetic_data(64, 32, 10)
+    images = ((images.astype(np.float32) / 255.0) - 0.5)
+    ds = device_data.DeviceDataset(mesh, images, labels, batch=16, seed=0)
+
+    def fresh_state():
+        s = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 32, 32, 3)))
+        return jax.device_put(s, replicated(mesh))
+
+    run_single = device_data.compile_resident_steps(base, ds, mesh, 1)
+    run_chunk4 = device_data.compile_resident_steps(base, ds, mesh, 4)
+
+    s1 = fresh_state()
+    for i in range(4):
+        s1, m1 = run_single(s1, i, 1)
+    s4, m4 = run_chunk4(fresh_state(), 0, 4)
+
+    assert int(jax.device_get(s4.step)) == 4
+    assert float(m1["loss"]) == pytest.approx(float(m4["loss"]), rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(s1.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(s4.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_chunk_len_respects_all_boundaries():
+    cfg = load_config("smoke")
+    cfg.train.steps_per_call = 10
+    cfg.train.log_every = 20
+    cfg.train.summary_every = 100
+    cfg.train.checkpoint_every = 50
+    spe = 390
+    step, hits = 0, []
+    while step < 120:
+        k = _chunk_len(step, 120, cfg.train, spe)
+        assert 1 <= k <= 10
+        step += k
+        hits.append(step)
+    # every multiple of every interval in range is an exact chunk end
+    for boundary in (20, 40, 50, 60, 80, 100, 120):
+        assert boundary in hits
+    assert step == 120
+    # epoch boundary is respected too
+    assert _chunk_len(385, 1000, cfg.train, spe) == 5
+
+
+def test_should_use_gating():
+    cfg = load_config("smoke")  # synthetic → in-memory
+    assert device_data.should_use(cfg.data)
+    cfg.data.device_resident = "off"
+    assert not device_data.should_use(cfg.data)
+    cfg.data.device_resident = "auto"
+    cfg.data.dataset = "imagenet"
+    assert not device_data.should_use(cfg.data)
+    cfg.data.device_resident = "on"  # forced-but-impossible must be loud
+    with pytest.raises(ValueError):
+        device_data.should_use(cfg.data)
+
+
+def test_run_rejects_oversized_chunk():
+    cfg = load_config("smoke")
+    cfg.train.global_batch_size = 16
+    mesh = _mesh()
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    base = make_train_step(model, cfg.optim, sched, cfg.data.num_classes,
+                           augment_fn=None, base_rng=jax.random.PRNGKey(1))
+    images, labels = synthetic_data(64, 32, 10)
+    ds = device_data.DeviceDataset(mesh, images, labels, batch=16)
+    run = device_data.compile_resident_steps(base, ds, mesh, 2)
+    state = jax.device_put(
+        init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3))), replicated(mesh))
+    with pytest.raises(ValueError):
+        run(state, 0, 3)
+
+
+def test_train_loop_resident_end_to_end(tmp_path):
+    """train() on the resident path: runs to train_steps, honors the
+    checkpoint interval, and resumes."""
+    cfg = load_config("smoke")
+    cfg.data.device_resident = "on"
+    cfg.train.steps_per_call = 7
+    cfg.train.train_steps = 60
+    cfg.train.checkpoint_every = 30
+    cfg.train.log_every = 10
+    cfg.train.train_dir = str(tmp_path)
+    mesh = _mesh()
+    state = train(cfg, mesh=mesh)
+    assert int(jax.device_get(state.step)) == 60
+    from tpu_resnet.train.checkpoint import CheckpointManager
+    assert CheckpointManager(str(tmp_path)).latest_step() == 60
+    # resume continues past the restored step
+    cfg.train.train_steps = 67
+    state = train(cfg, mesh=mesh)
+    assert int(jax.device_get(state.step)) == 67

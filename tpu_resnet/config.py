@@ -45,6 +45,13 @@ class DataConfig:
     image_size: int = 0  # 0 = dataset default (32 cifar / 224 imagenet)
     # Use the native C++ loader when the shared library is built.
     use_native_loader: bool = True
+    # Device-resident dataset (data/device_data.py): upload the whole
+    # training split to HBM once and cut batches on-device — removes all
+    # per-step host→device traffic. "auto" enables it for single-process
+    # in-memory datasets under ``resident_max_bytes``; "on" forces, "off"
+    # always streams through the host pipeline.
+    device_resident: str = "auto"  # auto | on | off
+    resident_max_bytes: int = 2 << 30
 
     @property
     def num_classes(self) -> int:
@@ -156,6 +163,11 @@ class TrainConfig:
     # Continuous-eval sidecar (resnet_cifar_eval.py:140-143)
     eval_interval_secs: int = 60
     eval_once: bool = False
+    # Steps fused into one dispatch via lax.scan on the device-resident
+    # path (amortizes host→device command latency). 1 = one dispatch per
+    # step; chunks are clipped to log/checkpoint/epoch boundaries so all
+    # intervals are honored exactly.
+    steps_per_call: int = 10
 
 
 @dataclasses.dataclass

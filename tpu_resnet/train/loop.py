@@ -27,6 +27,7 @@ import numpy as np
 from tpu_resnet import parallel
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
+from tpu_resnet.data import device_data
 from tpu_resnet.data import pipeline
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
@@ -50,6 +51,19 @@ def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
         capacity=cfg.data.prefetch + 2)
     return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
                                     depth=cfg.data.prefetch)
+
+
+def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int) -> int:
+    """Steps to run in the next fused dispatch: at most ``steps_per_call``,
+    clipped so the chunk ends exactly on the next log/summary/checkpoint/
+    epoch/stop boundary — every interval fires at precisely the same steps
+    a one-dispatch-per-step loop would fire them."""
+    k = max(1, train_cfg.steps_per_call)
+    for interval in (train_cfg.log_every, train_cfg.summary_every,
+                     train_cfg.checkpoint_every, steps_per_epoch):
+        if interval > 0:
+            k = min(k, interval - step % interval)
+    return min(k, total - step)
 
 
 def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
@@ -85,24 +99,49 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         metrics = MetricsWriter(cfg.train.train_dir,
                                 enabled=parallel.is_primary())
 
-    train_step = shard_step(
-        make_train_step(model, cfg.optim, schedule, cfg.data.num_classes,
-                        augment_fn, base_rng=step_rng, mesh=mesh), mesh)
+    base_step = make_train_step(model, cfg.optim, schedule,
+                                cfg.data.num_classes, augment_fn,
+                                base_rng=step_rng, mesh=mesh)
 
     step = int(jax.device_get(state.step))
-    data_iter = build_train_iterator(cfg, mesh, start_step=step)
     total = max_steps if max_steps is not None else cfg.train.train_steps
+
+    # Input edge: device-resident (whole split in HBM, batches cut
+    # on-device, multi-step dispatch) when it applies, else the streaming
+    # host pipeline.
+    resident = device_data.should_use(cfg.data)
+    if resident:
+        import tpu_resnet.data as data_lib
+
+        images_np, labels_np = data_lib.load_split(cfg.data, train=True)
+        ds = device_data.DeviceDataset(mesh, images_np, labels_np,
+                                       cfg.train.global_batch_size,
+                                       seed=cfg.train.seed)
+        run_chunk = device_data.compile_resident_steps(
+            base_step, ds, mesh, max(1, cfg.train.steps_per_call))
+        data_iter = None
+    else:
+        train_step = shard_step(base_step, mesh)
+        data_iter = build_train_iterator(cfg, mesh, start_step=step)
+
     meter = ThroughputMeter(cfg.train.global_batch_size)
     log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
-             "global batch %d", cfg.model.name, cfg.data.dataset, total,
-             n_params / 1e6, dict(mesh.shape), cfg.train.global_batch_size)
+             "global batch %d | input %s", cfg.model.name, cfg.data.dataset,
+             total, n_params / 1e6, dict(mesh.shape),
+             cfg.train.global_batch_size,
+             "device-resident" if resident else "streaming")
 
     meter.rate(step)
     last_summary = step
     while step < total:
-        images, labels = next(data_iter)
-        state, m = train_step(state, images, labels)
-        step += 1
+        if resident:
+            k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch)
+            state, m = run_chunk(state, step, k)
+            step += k
+        else:
+            images, labels = next(data_iter)
+            state, m = train_step(state, images, labels)
+            step += 1
 
         if step % cfg.train.log_every == 0 or step == total:
             m = {k: float(v) for k, v in jax.device_get(m).items()}
