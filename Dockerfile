@@ -1,0 +1,17 @@
+# Container image for launch/docker_cluster.sh — the analog of the
+# TF+Horovod images the reference's docker launchers assume
+# (start-resnet-cifar-train.sh docker exec payloads). Any base with a
+# jax[tpu] install works; this default targets TPU VM hosts.
+FROM python:3.11-slim
+
+RUN pip install --no-cache-dir "jax[tpu]" flax optax orbax-checkpoint \
+    einops numpy \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+WORKDIR /workspace
+COPY . /workspace
+# Build the native C++ data plane (falls back to numpy loaders if absent).
+RUN python -m tpu_resnet.native.build || true
+
+ENTRYPOINT []
+CMD ["python", "-m", "tpu_resnet", "train", "--preset", "smoke"]

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Docker bring-up — the TPU-native replacement for the reference's
+# container-per-task launchers (start-resnet-cifar-train.sh: bridge net
+# 10.20.30.0/24, one container per ps/worker with static IPs and
+# CUDA_VISIBLE_DEVICES pinning; start-resnet-*-horovod-train.sh: sshd +
+# mpirun mesh across containers; start-macvlan-2host.sh: macvlan for real
+# multi-machine).
+#
+# All of that collapses to "one container per host running the same
+# program": container 0 is the jax.distributed coordinator, the rest
+# rendezvous to it. No ps/worker roles, no ssh keys, no mpirun — the
+# collectives live in XLA, reached through the coordinator handshake.
+#
+#   ./launch/docker_cluster.sh [N] [IMAGE] [extra config overrides...]
+#
+# Env:
+#   NET_MODE=bridge|macvlan   docker network driver (macvlan + PARENT_IF
+#                             for real multi-machine, like the reference's
+#                             start-macvlan-2host.sh)
+#   PARENT_IF=eth0            parent interface for macvlan
+#   SUBNET=10.20.30.0/24      network subnet (reference uses the same)
+#   DEVICE_FLAGS="--privileged -v /dev:/dev"   accelerator passthrough
+#   EVAL_SIDECAR=1            also start an eval container polling the
+#                             shared train dir (the reference's tf-eval
+#                             container, start-resnet-imagenet-main.sh tail)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N="${1:-4}"; shift || true
+IMAGE="${1:-tpu_resnet:latest}"; shift || true
+NET="${NET:-tpu-resnet-net}"
+SUBNET="${SUBNET:-10.20.30.0/24}"
+NET_MODE="${NET_MODE:-bridge}"
+TRAIN_DIR="${TRAIN_DIR:-/tmp/tpu_resnet/docker-run}"
+COORD_IP="${SUBNET%.*/*}.100"
+PORT=8476
+
+docker network inspect "$NET" >/dev/null 2>&1 || \
+  if [ "$NET_MODE" = macvlan ]; then
+    docker network create -d macvlan --subnet="$SUBNET" \
+      -o parent="${PARENT_IF:-eth0}" "$NET"
+  else
+    docker network create --subnet="$SUBNET" "$NET"
+  fi
+
+mkdir -p "$TRAIN_DIR"
+cids=()
+for ((i = 0; i < N; i++)); do
+  ip="${SUBNET%.*/*}.$((100 + i))"
+  cids+=("$(docker run -d --name "tpu-resnet-$i" --rm \
+    --network "$NET" --ip "$ip" \
+    -v "$PWD:/workspace" -v "$TRAIN_DIR:$TRAIN_DIR" -w /workspace \
+    -e TPU_COORDINATOR_ADDRESS="$COORD_IP:$PORT" \
+    -e TPU_NUM_PROCESSES="$N" \
+    -e TPU_PROCESS_ID="$i" \
+    ${DEVICE_FLAGS:-} \
+    "$IMAGE" python -m tpu_resnet train \
+      "$@" train.train_dir="$TRAIN_DIR")")
+  echo "started tpu-resnet-$i @ $ip (${cids[-1]})"
+done
+
+if [ "${EVAL_SIDECAR:-0}" = 1 ]; then
+  docker run -d --name tpu-resnet-eval --rm --network "$NET" \
+    -v "$PWD:/workspace" -v "$TRAIN_DIR:$TRAIN_DIR" -w /workspace \
+    "$IMAGE" python -m tpu_resnet eval "$@" train.train_dir="$TRAIN_DIR"
+  echo "started eval sidecar"
+fi
+
+echo "follow logs: docker logs -f tpu-resnet-0"
+echo "teardown:    ./launch/stop.sh docker"

@@ -1,7 +1,27 @@
 #!/usr/bin/env bash
-# Teardown — the reference's stop.sh / mkl-scripts/kill.sh equivalent,
-# scoped to this framework's processes instead of `kill -9` on all python.
+# Teardown — the reference's stop.sh / stop-2.sh / mkl-scripts/kill.sh
+# equivalent, scoped to this framework's processes (and containers with
+# `stop.sh docker`) instead of `kill -9` on all python.
 set -uo pipefail
+if [ "${1:-}" = docker ]; then
+  NET="${NET:-tpu-resnet-net}"
+  ids="$(docker ps -aq --filter name='tpu-resnet-')"
+  if [ -n "$ids" ]; then
+    docker stop $ids
+    docker wait $ids 2>/dev/null || true  # let --rm removal finish
+  fi
+  # endpoints can take a moment to detach even after wait
+  for _ in 1 2 3 4 5; do
+    docker network rm "$NET" 2>/dev/null && break
+    docker network inspect "$NET" >/dev/null 2>&1 || break
+    sleep 1
+  done
+  if docker network inspect "$NET" >/dev/null 2>&1; then
+    echo "warning: network $NET still present (active endpoints?)" >&2
+  fi
+  echo "stopped tpu-resnet containers"
+  exit 0
+fi
 pkill -f "python -m tpu_resnet" 2>/dev/null
 pkill -f "tpu_resnet/main.py" 2>/dev/null
 echo "stopped tpu_resnet processes"

@@ -1,0 +1,70 @@
+"""Profiling subsystem (tools/profiling.py) and train_and_eval mode —
+the tracing/observability parity items of SURVEY.md §5."""
+
+import glob
+import os
+
+import jax
+import pytest
+
+from tpu_resnet.config import load_config
+from tpu_resnet.evaluation import train_and_eval
+from tpu_resnet.parallel import create_mesh
+from tpu_resnet.tools import profiling
+from tpu_resnet.train.loop import _chunk_len, train
+
+
+def test_parse_window():
+    assert profiling.parse_window("") is None
+    assert profiling.parse_window("100:120") == (100, 120)
+    with pytest.raises(ValueError):
+        profiling.parse_window("120:100")
+    with pytest.raises(ValueError):
+        profiling.parse_window("abc")
+
+
+def test_chunk_len_respects_trace_window():
+    cfg = load_config("smoke")
+    cfg.train.steps_per_call = 10
+    cfg.train.log_every = 100
+    cfg.train.summary_every = 100
+    cfg.train.checkpoint_every = 100
+    # 95 → 100 (log boundary), 100 → 103 (window start), 103 → 107
+    # (window end): fused chunks never straddle the trace window.
+    assert _chunk_len(95, 1000, cfg.train, 10_000, (103, 107)) == 5
+    assert _chunk_len(100, 1000, cfg.train, 10_000, (103, 107)) == 3
+    assert _chunk_len(103, 1000, cfg.train, 10_000, (103, 107)) == 4
+
+
+def test_trace_window_during_training(tmp_path):
+    """A traced run writes a profile under <train_dir>/profile and the
+    trace covers whole chunks (no straddle)."""
+    cfg = load_config("smoke")
+    cfg.data.device_resident = "on"
+    cfg.train.steps_per_call = 4
+    cfg.train.train_steps = 20
+    cfg.train.checkpoint_every = 20
+    cfg.train.profile_steps = "6:10"
+    cfg.train.train_dir = str(tmp_path)
+    mesh = create_mesh(cfg.mesh, devices=jax.devices()[:8])
+    state = train(cfg, mesh=mesh)
+    assert int(jax.device_get(state.step)) == 20
+    profile_dir = os.path.join(str(tmp_path), "profile")
+    assert os.path.isdir(profile_dir)
+    assert glob.glob(os.path.join(profile_dir, "**", "*.xplane.pb"),
+                     recursive=True)
+
+
+def test_train_and_eval(tmp_path):
+    """train_and_eval trains to completion and produces the sidecar's
+    best-precision artifact for the final checkpoint."""
+    cfg = load_config("smoke")
+    cfg.train.train_steps = 20
+    cfg.train.checkpoint_every = 10
+    cfg.train.eval_interval_secs = 1
+    cfg.train.train_dir = str(tmp_path)
+    mesh = create_mesh(cfg.mesh, devices=jax.devices()[:8])
+    precision = train_and_eval(cfg, mesh=mesh)
+    assert precision is not None and 0.0 <= precision <= 1.0
+    best = os.path.join(str(tmp_path), "eval", "best_precision.json")
+    assert os.path.exists(best)

@@ -168,6 +168,11 @@ class TrainConfig:
     # step; chunks are clipped to log/checkpoint/epoch boundaries so all
     # intervals are honored exactly.
     steps_per_call: int = 10
+    # Profiling (tools/profiling.py): port for the live jax.profiler
+    # service (0 = off) and an optional "start:stop" step window traced
+    # into <train_dir>/profile.
+    profiler_port: int = 0
+    profile_steps: str = ""
 
 
 @dataclasses.dataclass

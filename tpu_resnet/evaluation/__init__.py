@@ -2,6 +2,7 @@ from tpu_resnet.evaluation.evaluator import (
     build_eval_step,
     evaluate,
     run_eval_pass,
+    train_and_eval,
 )
 
-__all__ = ["build_eval_step", "evaluate", "run_eval_pass"]
+__all__ = ["build_eval_step", "evaluate", "run_eval_pass", "train_and_eval"]

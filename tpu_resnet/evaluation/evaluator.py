@@ -82,8 +82,13 @@ def _template_state(cfg: RunConfig, model, mesh):
     return jax.device_put(state, parallel.replicated(mesh))
 
 
-def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
-    """Continuous (or once) evaluation; returns last precision."""
+def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
+    """Continuous (or once) evaluation; returns last precision.
+
+    ``stop_event`` (a ``threading.Event``) ends the polling loop early —
+    used by train_and_eval to stop the in-process sidecar when training
+    finishes (the reference runs the sidecar as a separate container/node,
+    start-resnet-imagenet-main.sh tail, and kills it with stop.sh)."""
     if mesh is None:
         mesh = parallel.create_mesh(cfg.mesh)
     model, eval_step_fn = build_eval_step(cfg, mesh)
@@ -99,6 +104,13 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
 
     ckpt = CheckpointManager(cfg.train.train_dir,
                              keep=cfg.train.keep_checkpoints)
+    def _wait() -> bool:
+        """Sleep one poll interval; True = keep going, False = stop."""
+        if stop_event is not None:
+            return not stop_event.wait(cfg.train.eval_interval_secs)
+        time.sleep(cfg.train.eval_interval_secs)
+        return True
+
     last_seen = None
     precision = None
     while True:
@@ -109,7 +121,8 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
             log.info("no checkpoint yet in %s; sleeping", cfg.train.train_dir)
             if cfg.train.eval_once:
                 return None
-            time.sleep(cfg.train.eval_interval_secs)
+            if not _wait():
+                break
             continue
         if step != last_seen:
             state = ckpt.restore(template, step=step)
@@ -131,6 +144,78 @@ def evaluate(cfg: RunConfig, mesh=None) -> Optional[float]:
             last_seen = step
         if cfg.train.eval_once:
             break
-        time.sleep(cfg.train.eval_interval_secs)
+        if not _wait():
+            break
     metrics.close()
     return precision
+
+
+def _last_eval(train_dir: str) -> Tuple[Optional[int], Optional[float]]:
+    """(step, precision) of the newest eval record in <train_dir>/eval."""
+    path = os.path.join(train_dir, "eval", "metrics.jsonl")
+    step = precision = None
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail write from a live sidecar
+                if "Precision" in rec:
+                    step, precision = rec.get("step"), rec["Precision"]
+    return step, precision
+
+
+def train_and_eval(cfg: RunConfig, mesh=None) -> Optional[float]:
+    """Train with an in-process eval sidecar — the reference's
+    ``--mode=train_and_eval`` (resnet_cifar_main.py main dispatch; its
+    ImageNet variant is broken, resnet_imagenet_main.py:528-529 calls
+    train with an undefined ``server`` — SURVEY.md §2.1). Here both share
+    one process and mesh: the sidecar thread polls/evaluates between
+    training dispatches, and a final eval-once covers the last checkpoint
+    when the sidecar didn't. Returns the final precision.
+
+    Single-process only: with multiple processes, each host's sidecar
+    would enqueue collectives interleaved differently with the training
+    stream and deadlock the mesh — multi-host runs launch the evaluator
+    as its own process/job like the reference's tf-eval container
+    (start-resnet-imagenet-main.sh tail, run_dist_train_eval_daint.sh).
+    """
+    import copy
+    import threading
+
+    from tpu_resnet import parallel as par
+    from tpu_resnet.train.loop import train as train_fn
+
+    if jax.process_count() != 1:
+        raise ValueError(
+            "train_and_eval is single-process; in multi-host runs start "
+            "`tpu_resnet eval` as a separate process/job instead")
+    if mesh is None:
+        mesh = par.create_mesh(cfg.mesh)
+
+    eval_cfg = copy.deepcopy(cfg)
+    eval_cfg.train.eval_once = False
+    stop = threading.Event()
+    sidecar = threading.Thread(
+        target=evaluate, args=(eval_cfg,),
+        kwargs=dict(mesh=mesh, stop_event=stop), daemon=True)
+    sidecar.start()
+    try:
+        train_fn(cfg, mesh=mesh)
+    finally:
+        stop.set()
+    sidecar.join(timeout=600)
+    if sidecar.is_alive():
+        log.warning("eval sidecar still mid-pass after 600s; skipping the "
+                    "final eval to avoid concurrent device work")
+        return _last_eval(cfg.train.train_dir)[1]
+
+    seen_step, seen_precision = _last_eval(cfg.train.train_dir)
+    if seen_step is not None and seen_step == latest_step_in(
+            cfg.train.train_dir):
+        return seen_precision  # sidecar already covered the last checkpoint
+
+    final_cfg = copy.deepcopy(cfg)
+    final_cfg.train.eval_once = True
+    return evaluate(final_cfg, mesh=mesh)

@@ -30,6 +30,7 @@ def main(argv=None):
     sub = parser.add_subparsers(dest="command", required=True)
     for name, help_text in [
         ("train", "run the training loop"),
+        ("train_and_eval", "train with an in-process eval sidecar"),
         ("eval", "continuous checkpoint-polling evaluation (or --once)"),
         ("info", "print resolved config, param count and per-step FLOPs"),
         ("export", "freeze a checkpoint into a serialized inference artifact"),
@@ -70,6 +71,13 @@ def main(argv=None):
         from tpu_resnet.train import train
         parallel.initialize()
         train(cfg)
+        return 0
+
+    if args.command == "train_and_eval":
+        from tpu_resnet import parallel
+        from tpu_resnet.evaluation import train_and_eval
+        parallel.initialize()
+        train_and_eval(cfg)
         return 0
 
     if args.command == "eval":

@@ -1,0 +1,97 @@
+"""Profiling — the TPU equivalents of the reference's tracing hooks
+(SURVEY.md §5): tfprof param/FLOP analysis (reference resnet_single.py:58-66
+→ tools/analysis.py), ``NCCL_DEBUG=INFO`` transport tracing
+(start-resnet-cifar-horovod-train.sh:119) and the Slurm profiling one-liner
+(mkl-scripts/profile_dist_ps_cori.sh:1) → ``jax.profiler``:
+
+- ``maybe_start_server(port)`` exposes the live profiler service
+  (``train.profiler_port``) so TensorBoard / ``xprof`` can attach to a
+  running job — the role NCCL debug output played for transport visibility.
+- ``StepTracer`` captures a device trace of a step window
+  (``train.profile_steps = "100:120"``) into ``<train_dir>/profile`` —
+  the per-step timeline the reference could only infer from
+  LoggingTensorHook timestamps (resnet_cifar_train.py:282-287).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import jax
+
+log = logging.getLogger("tpu_resnet")
+
+
+_server = None
+
+
+def maybe_start_server(port: int):
+    """Start the profiler gRPC server when ``port`` > 0 (idempotent per
+    process — jax allows only one); returns the server handle or None."""
+    global _server
+    if not port:
+        return None
+    if _server is None:
+        _server = jax.profiler.start_server(port)
+        log.info("profiler server listening on :%d (attach with TensorBoard "
+                 "profile or xprof)", port)
+    return _server
+
+
+def parse_window(spec: str) -> Optional[Tuple[int, int]]:
+    """``"start:stop"`` → (start, stop) step window, or None when empty."""
+    if not spec:
+        return None
+    try:
+        a, b = spec.split(":")
+        start, stop = int(a), int(b)
+    except ValueError:
+        raise ValueError(
+            f"train.profile_steps must be 'start:stop', got {spec!r}")
+    if not 0 <= start < stop:
+        raise ValueError(f"bad profile window {spec!r}: need 0 <= start < stop")
+    return start, stop
+
+
+class StepTracer:
+    """Drives ``jax.profiler`` start/stop at training-step boundaries.
+
+    The training loop calls ``before(step)`` ahead of dispatching the chunk
+    that begins at ``step`` and ``after(step)`` once the host step counter
+    has advanced past it. ``boundaries()`` feeds the loop's chunk clipper so
+    fused multi-step dispatches never straddle the trace window.
+    """
+
+    def __init__(self, train_dir: str, spec: str = ""):
+        self.window = parse_window(spec)
+        self.dir = os.path.join(train_dir, "profile")
+        self._active = False
+
+    def boundaries(self) -> Tuple[int, ...]:
+        return self.window or ()
+
+    def before(self, step: int) -> None:
+        if (self.window and not self._active and
+                self.window[0] <= step < self.window[1]):
+            os.makedirs(self.dir, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+            log.info("profiler: tracing steps %d..%d into %s",
+                     self.window[0], self.window[1], self.dir)
+
+    def after(self, step: int, sync=None) -> None:
+        if self._active and step >= self.window[1]:
+            if sync is not None:  # drain async dispatches so the device
+                jax.block_until_ready(sync)  # work lands inside the trace
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("profiler: trace written to %s", self.dir)
+
+    def close(self, sync=None) -> None:
+        if self._active:  # training ended inside the window
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+            self._active = False

@@ -30,6 +30,7 @@ from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.data import device_data
 from tpu_resnet.data import pipeline
 from tpu_resnet.models import build_model
+from tpu_resnet.tools import profiling
 from tpu_resnet.train import schedule as sched_lib
 from tpu_resnet.train.checkpoint import CheckpointManager
 from tpu_resnet.train.metrics_io import MetricsWriter, ThroughputMeter
@@ -53,16 +54,21 @@ def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
                                     depth=cfg.data.prefetch)
 
 
-def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int) -> int:
+def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int,
+               extra_boundaries: tuple = ()) -> int:
     """Steps to run in the next fused dispatch: at most ``steps_per_call``,
     clipped so the chunk ends exactly on the next log/summary/checkpoint/
     epoch/stop boundary — every interval fires at precisely the same steps
-    a one-dispatch-per-step loop would fire them."""
+    a one-dispatch-per-step loop would fire them. ``extra_boundaries`` are
+    absolute steps (e.g. a profiler trace window) chunks must not straddle."""
     k = max(1, train_cfg.steps_per_call)
     for interval in (train_cfg.log_every, train_cfg.summary_every,
                      train_cfg.checkpoint_every, steps_per_epoch):
         if interval > 0:
             k = min(k, interval - step % interval)
+    for b in extra_boundaries:
+        if b > step:
+            k = min(k, b - step)
     return min(k, total - step)
 
 
@@ -131,17 +137,25 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
              cfg.train.global_batch_size,
              "device-resident" if resident else "streaming")
 
+    profiling.maybe_start_server(cfg.train.profiler_port)
+    tracer = profiling.StepTracer(cfg.train.train_dir,
+                                  cfg.train.profile_steps)
+
     meter.rate(step)
     last_summary = step
+    m = None  # metrics of the newest dispatched chunk
     while step < total:
+        tracer.before(step)
         if resident:
-            k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch)
+            k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch,
+                           tracer.boundaries())
             state, m = run_chunk(state, step, k)
             step += k
         else:
             images, labels = next(data_iter)
             state, m = train_step(state, images, labels)
             step += 1
+        tracer.after(step, sync=m)
 
         if step % cfg.train.log_every == 0 or step == total:
             m = {k: float(v) for k, v in jax.device_get(m).items()}
@@ -160,6 +174,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         if step % cfg.train.checkpoint_every == 0 or step == total:
             ckpt.save(step, state)
 
+    tracer.close(sync=m)
     ckpt.wait()
     metrics.close()
     return state
