@@ -95,6 +95,14 @@ class ModelConfig:
     # bf16 compute on the MXU with fp32 params/BN stats. "float32" for
     # bit-exact CPU tests.
     compute_dtype: str = "bfloat16"
+    # True (default): BN moments over the global batch — the natural
+    # semantics of one auto-sharded SPMD program. False: per-replica BN,
+    # the reference's semantics (each worker's update_ops ran on its own
+    # batch, resnet_model.py:120-122), compiled via shard_map with
+    # explicit pmean of grads/stats. The reference's distributed accuracy
+    # gap (README.md:36) is partly this; both are offered so the delta
+    # can be measured.
+    sync_bn: bool = True
     # MLP sanity model (reference logist_model.py:11) hidden units.
     mlp_hidden_units: int = 100
 

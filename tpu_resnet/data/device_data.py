@@ -154,19 +154,31 @@ def make_chunked_step(step_fn: Callable, k: int):
 
 
 def compile_resident_steps(base_step: Callable, ds: DeviceDataset,
-                           mesh: Mesh, steps_per_call: int):
+                           mesh: Mesh, steps_per_call: int,
+                           per_replica_bn: bool = False):
     """Returns ``run(state, k) -> (state, metrics)`` executing ``k`` steps
     (k ≤ steps_per_call) in one dispatch against the resident dataset.
     Distinct k values compile once each (the training loop only uses the
-    handful of chunk sizes its log/checkpoint boundaries require)."""
+    handful of chunk sizes its log/checkpoint boundaries require).
+
+    ``per_replica_bn`` wraps each chunk in ``shard_map`` (see
+    train/step.py::shard_step); the epoch buffer's batch axis is sharded
+    over 'data', so each replica slices its own local rows."""
     resident = make_resident_step(base_step, ds.steps_per_epoch)
     repl = NamedSharding(mesh, P())
     cache = {}
 
     def compiled(k: int):
         if k not in cache:
+            chunk = make_chunked_step(resident, k)
+            if per_replica_bn:
+                from tpu_resnet.train.step import per_replica_shard_map
+
+                chunk = per_replica_shard_map(
+                    chunk, mesh,
+                    in_specs=(P(), P(None, "data"), P(None, "data")))
             cache[k] = jax.jit(
-                make_chunked_step(resident, k),
+                chunk,
                 in_shardings=(repl, ds._buf_sharding, ds._buf_sharding),
                 donate_argnums=(0,),
             )

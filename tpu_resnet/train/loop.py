@@ -105,9 +105,14 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         metrics = MetricsWriter(cfg.train.train_dir,
                                 enabled=parallel.is_primary())
 
+    # Per-replica BN (reference semantics, model.sync_bn=False) runs the
+    # step inside shard_map with explicit pmeans; the default is global-
+    # batch BN under auto-sharded jit.
+    per_replica_bn = (not cfg.model.sync_bn) and mesh.shape["data"] > 1
     base_step = make_train_step(model, cfg.optim, schedule,
                                 cfg.data.num_classes, augment_fn,
-                                base_rng=step_rng, mesh=mesh)
+                                base_rng=step_rng, mesh=mesh,
+                                grad_axis="data" if per_replica_bn else None)
 
     step = int(jax.device_get(state.step))
     total = max_steps if max_steps is not None else cfg.train.train_steps
@@ -124,10 +129,12 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                                        cfg.train.global_batch_size,
                                        seed=cfg.train.seed)
         run_chunk = device_data.compile_resident_steps(
-            base_step, ds, mesh, max(1, cfg.train.steps_per_call))
+            base_step, ds, mesh, max(1, cfg.train.steps_per_call),
+            per_replica_bn=per_replica_bn)
         data_iter = None
     else:
-        train_step = shard_step(base_step, mesh)
+        train_step = shard_step(base_step, mesh,
+                                per_replica_bn=per_replica_bn)
         data_iter = build_train_iterator(cfg, mesh, start_step=step)
 
     meter = ThroughputMeter(cfg.train.global_batch_size)

@@ -65,28 +65,44 @@ def l2_weight_penalty(params, include_bn: bool) -> jnp.ndarray:
 def make_train_step(model, optim_cfg, schedule, num_classes: int,
                     augment_fn: Optional[Callable] = None,
                     base_rng: Optional[jax.Array] = None,
-                    mesh: Optional[Mesh] = None):
+                    mesh: Optional[Mesh] = None,
+                    grad_axis: Optional[str] = None):
     """Returns ``train_step(state, images, labels) -> (state, metrics)``.
 
     ``images`` may be raw uint8 (augment_fn applied on device) or
     pre-processed floats (augment_fn=None).
+
+    ``grad_axis`` selects the per-replica-BN SPMD style: when set, the step
+    is meant to run inside ``shard_map`` over that mesh axis — BN moments
+    come from the *local* batch shard (the reference's per-worker BN
+    update_ops, resnet_model.py:120-122), and gradients / metrics / stored
+    BN stats are explicitly ``pmean``-ed across the axis. When None (the
+    default), the step runs under auto-sharded ``jit`` and BN moments are
+    global-batch (synced BN); XLA inserts the gradient all-reduces.
     """
     tx = build_optimizer(optim_cfg, schedule)
     if base_rng is None:
         base_rng = jax.random.PRNGKey(0)
 
-    # Fused Pallas xent: used on TPU for single-device meshes (under a
-    # multi-device auto-sharded jit, a pallas_call has no partitioning rule,
-    # so there XLA's own softmax fusion stays in charge).
+    # Fused Pallas xent on TPU: single-device jit, or any shard_map body
+    # (there the kernel sees the local shard — no partitioning rule
+    # needed). Under a multi-device auto-sharded jit, XLA's own softmax
+    # fusion stays in charge.
     use_pallas = (getattr(optim_cfg, "use_pallas_xent", False)
                   and optim_cfg.label_smoothing == 0.0
                   and jax.default_backend() == "tpu"
-                  and (mesh is None or mesh.size == 1))
+                  and (grad_axis is not None or mesh is None
+                       or mesh.size == 1))
     if use_pallas:
         from tpu_resnet.ops import softmax_xent_mean as _pallas_xent
 
     def train_step(state: TrainState, images, labels):
         rng = jax.random.fold_in(base_rng, state.step)
+        if grad_axis is not None:
+            # Distinct augmentation stream per shard — without this every
+            # replica would replay the same crops/flips on its slot-j
+            # example.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(grad_axis))
         if augment_fn is not None:
             images = augment_fn(rng, images)
 
@@ -105,17 +121,26 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
 
         (loss, (logits, new_model_state)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        new_batch_stats = new_model_state["batch_stats"]
+        precision = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        if grad_axis is not None:
+            # Explicit ICI all-reduces (the shard_map analog of what XLA
+            # emits on the jit path): average grads; average the EMA stats
+            # so the stored state is one consistent replicated tree.
+            grads = jax.lax.pmean(grads, grad_axis)
+            new_batch_stats = jax.lax.pmean(new_batch_stats, grad_axis)
+            loss = jax.lax.pmean(loss, grad_axis)
+            precision = jax.lax.pmean(precision, grad_axis)
         updates, new_opt_state = tx.update(grads, state.opt_state,
                                            state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
-            batch_stats=new_model_state["batch_stats"],
+            batch_stats=new_batch_stats,
             opt_state=new_opt_state,
         )
-        precision = jnp.mean(
-            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
         metrics = {
             "loss": loss,
             "precision": precision,
@@ -151,12 +176,32 @@ def make_eval_step(model, num_classes: int,
     return eval_step
 
 
-def shard_step(step_fn, mesh: Mesh, donate_state: bool = True):
+def per_replica_shard_map(fn, mesh: Mesh, in_specs):
+    """Wrap a step/chunk built with ``grad_axis='data'`` in shard_map.
+    Outputs (state, metrics) are replicated by construction — every shard
+    applies the same pmean-ed grads/stats — hence ``out_specs=P()`` with
+    VMA checking off (the explicit pmeans are the replication proof)."""
+    from jax import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=(P(), P()), check_vma=False)
+
+
+def shard_step(step_fn, mesh: Mesh, donate_state: bool = True,
+               per_replica_bn: bool = False):
     """Compile a step for the mesh: batch split over 'data', state
     replicated. XLA emits the gradient/BN all-reduces over ICI — the entire
-    replacement for ps push/pull + Horovod fusion threads."""
+    replacement for ps push/pull + Horovod fusion threads.
+
+    ``per_replica_bn=True`` compiles the ``shard_map`` variant: the step
+    body (built with ``grad_axis='data'``) sees only its local batch shard,
+    so BN moments are per-replica like the reference's, and the body's
+    explicit ``pmean``s carry the cross-replica reductions."""
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
+    if per_replica_bn:
+        step_fn = per_replica_shard_map(
+            step_fn, mesh, in_specs=(P(), P("data"), P("data")))
     return jax.jit(
         step_fn,
         in_shardings=(repl, data, data),
