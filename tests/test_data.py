@@ -176,3 +176,29 @@ def test_imagenet_mean_subtraction():
     out = np.asarray(augment.imagenet_eval_preprocess(imgs))
     want = 1.0 - np.asarray(augment.VGG_MEANS_01)
     np.testing.assert_allclose(out[0, 0, 0], want, rtol=1e-5)
+
+
+def test_staged_device_prefetch_matches_unstaged():
+    """Staged (k batches per transfer) must yield the exact same stream as
+    per-batch transfers, including a partial final stage."""
+    import jax
+
+    from tpu_resnet.parallel import (batch_sharding, create_mesh,
+                                     staged_batch_sharding)
+    from tpu_resnet.config import load_config
+
+    mesh = create_mesh(load_config("smoke").mesh, devices=jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    n_batches, B = 11, 16  # 11 batches, stage=4 -> stages of 4,4,3
+    batches = [(rng.integers(0, 255, (B, 8, 8, 3)).astype(np.uint8),
+                rng.integers(0, 10, B).astype(np.int32))
+               for _ in range(n_batches)]
+
+    plain = list(pipeline.device_prefetch(iter(batches),
+                                          batch_sharding(mesh)))
+    staged = list(pipeline.staged_device_prefetch(
+        iter(batches), staged_batch_sharding(mesh), stage=4))
+    assert len(plain) == len(staged) == n_batches
+    for (pi, pl), (si, sl) in zip(plain, staged):
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(si))
+        np.testing.assert_array_equal(np.asarray(pl), np.asarray(sl))

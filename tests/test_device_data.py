@@ -155,3 +155,17 @@ def test_train_loop_resident_end_to_end(tmp_path):
     cfg.train.train_steps = 67
     state = train(cfg, mesh=mesh)
     assert int(jax.device_get(state.step)) == 67
+
+
+def test_train_loop_streaming_staged(tmp_path):
+    """device_resident=off exercises the staged streaming input edge
+    end-to-end through train()."""
+    cfg = load_config("smoke")
+    cfg.data.device_resident = "off"
+    cfg.data.transfer_stage = 3
+    cfg.train.train_steps = 10
+    cfg.train.checkpoint_every = 10
+    cfg.train.train_dir = str(tmp_path)
+    mesh = _mesh()
+    state = train(cfg, mesh=mesh)
+    assert int(jax.device_get(state.step)) == 10

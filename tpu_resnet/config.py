@@ -52,6 +52,10 @@ class DataConfig:
     # always streams through the host pipeline.
     device_resident: str = "auto"  # auto | on | off
     resident_max_bytes: int = 2 << 30
+    # Streaming path: batches staged per host→device transfer (amortizes
+    # per-transfer command latency; per-step batches are cut on-device).
+    # 1 = one transfer per batch.
+    transfer_stage: int = 4
 
     @property
     def num_classes(self) -> int:

@@ -4,6 +4,7 @@ from tpu_resnet.parallel.mesh import (
     create_mesh,
     local_batch_size,
     replicated,
+    staged_batch_sharding,
 )
 from tpu_resnet.parallel.multihost import initialize, is_primary
 
@@ -13,6 +14,7 @@ __all__ = [
     "create_mesh",
     "local_batch_size",
     "replicated",
+    "staged_batch_sharding",
     "initialize",
     "is_primary",
 ]

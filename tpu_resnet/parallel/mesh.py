@@ -52,6 +52,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def staged_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """For (stage, batch, ...) superbatches: batch axis (axis 1) split over
+    'data', stage axis replicated (pipeline.staged_device_prefetch)."""
+    return NamedSharding(mesh, P(None, "data"))
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
     """Per-process batch for the host input pipeline."""
     n_proc = jax.process_count()

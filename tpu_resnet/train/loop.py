@@ -42,14 +42,19 @@ log = logging.getLogger("tpu_resnet")
 
 def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
     """Host pipeline: per-process shard → background batcher → device
-    prefetch queue."""
+    prefetch queue (staged: ``transfer_stage`` batches per transfer)."""
     import tpu_resnet.data as data_lib
 
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
+    stage = max(1, cfg.data.transfer_stage)
     host_iter = pipeline.BackgroundIterator(
         data_lib.train_batches(cfg.data, local_bs, seed=cfg.train.seed,
                                start_step=start_step),
-        capacity=cfg.data.prefetch + 2)
+        capacity=stage * cfg.data.prefetch + 2)
+    if stage > 1:
+        return pipeline.staged_device_prefetch(
+            host_iter, parallel.staged_batch_sharding(mesh),
+            stage=stage, depth=cfg.data.prefetch)
     return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
                                     depth=cfg.data.prefetch)
 
