@@ -63,3 +63,44 @@ def test_tfrecord_corruption_detected(native, tmp_path):
         native.tfrecord_payloads(path, verify_crc=True)
     with pytest.raises(ValueError):
         list(tfrecord.read_records(path, verify_crc=True))
+
+
+def test_jpeg_decode_matches_pil(native, tmp_path):
+    """Native libjpeg decode+resize+crop tracks the PIL path within
+    rounding (same random draws → interchangeable per image)."""
+    from tpu_resnet.native import jpeg_available
+
+    if not jpeg_available():
+        pytest.skip("built without libjpeg")
+    import io
+
+    from PIL import Image
+
+    from tpu_resnet.data import imagenet as inet
+
+    rng0 = np.random.default_rng(0)
+    img = (rng0.random((96, 128, 3)) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, "JPEG", quality=95)
+    jpeg = buf.getvalue()
+
+    for train in (False, True):
+        r1 = np.random.default_rng(7)
+        r2 = np.random.default_rng(7)
+        nat = inet.decode_and_crop(jpeg, train, r1, resize_min=72,
+                                   resize_max=90, eval_resize=80,
+                                   out_size=64, use_native=True)
+        pil = inet.decode_and_crop(jpeg, train, r2, resize_min=72,
+                                   resize_max=90, eval_resize=80,
+                                   out_size=64, use_native=False)
+        assert nat.shape == pil.shape == (64, 64, 3)
+        diff = np.abs(nat.astype(int) - pil.astype(int))
+        assert diff.max() <= 2, f"train={train}: max diff {diff.max()}"
+
+
+def test_jpeg_decode_bad_input_returns_none(native):
+    from tpu_resnet.native import jpeg_available, loader
+
+    if not jpeg_available():
+        pytest.skip("built without libjpeg")
+    assert loader.decode_jpeg_vgg(b"not a jpeg", 256, 224) is None

@@ -80,30 +80,66 @@ def parse_record(serialized: bytes) -> Tuple[bytes, int]:
 def _resize_keep_aspect(img: "Image.Image", smaller_side: int) -> "Image.Image":
     w, h = img.size
     scale = smaller_side / min(w, h)
-    return img.resize((max(1, round(w * scale)), max(1, round(h * scale))),
-                      Image.BILINEAR)
+    # round-half-up, matching the native path's lround — Python round()
+    # half-rounds to even, which would give a 1px-different grid on
+    # exact-.5 products
+    return img.resize((max(1, int(w * scale + 0.5)),
+                       max(1, int(h * scale + 0.5))), Image.BILINEAR)
+
+
+def _native_decoder():
+    """The C++ decode function when the JPEG-enabled library is built."""
+    try:
+        from tpu_resnet.native import jpeg_available, loader
+        if jpeg_available():
+            return loader.decode_jpeg_vgg
+    except Exception:
+        pass
+    return None
+
+
+_NATIVE_DECODE = None
+_NATIVE_PROBED = False
 
 
 def decode_and_crop(jpeg: bytes, train: bool, rng: np.random.Generator,
                     resize_min: int = 256, resize_max: int = 512,
                     eval_resize: int = EVAL_RESIZE,
-                    out_size: int = IMAGE_SIZE) -> np.ndarray:
+                    out_size: int = IMAGE_SIZE,
+                    use_native: bool = True) -> np.ndarray:
     """JPEG bytes → uint8 [out_size, out_size, 3] per VGG preprocessing
-    (host half; see module docstring)."""
+    (host half; see module docstring).
+
+    Random draws (resize side, crop fractions) happen once up front, so
+    the native C++ decoder (GIL-free libjpeg + bilinear, native/loader.cc)
+    and the PIL fallback consume the same stream and are interchangeable
+    per-image — unsupported images (CMYK, non-JPEG bytes) silently fall
+    back to PIL."""
+    global _NATIVE_DECODE, _NATIVE_PROBED
+    if train:
+        side = int(rng.integers(resize_min, resize_max + 1))
+        fx, fy = float(rng.random()), float(rng.random())
+    else:
+        side = eval_resize
+        fx = fy = -1.0  # floor-central crop in both decoders
+    if use_native:
+        if not _NATIVE_PROBED:
+            _NATIVE_DECODE = _native_decoder()
+            _NATIVE_PROBED = True
+        if _NATIVE_DECODE is not None:
+            out = _NATIVE_DECODE(jpeg, side, out_size, fx, fy)
+            if out is not None:
+                return out
     img = Image.open(io.BytesIO(jpeg))
     if img.mode != "RGB":
         img = img.convert("RGB")
-    if train:
-        side = int(rng.integers(resize_min, resize_max + 1))
-        img = _resize_keep_aspect(img, side)
-        w, h = img.size
-        x0 = int(rng.integers(0, w - out_size + 1))
-        y0 = int(rng.integers(0, h - out_size + 1))
-    else:
-        img = _resize_keep_aspect(img, eval_resize)
-        w, h = img.size
-        x0 = (w - out_size) // 2
-        y0 = (h - out_size) // 2
+    img = _resize_keep_aspect(img, side)
+    w, h = img.size
+    if fx < 0:  # eval: floor-central crop (vgg_preprocessing.py:171-193)
+        x0, y0 = (w - out_size) // 2, (h - out_size) // 2
+    else:  # train: fx/fy map uniformly onto the w-out+1 valid offsets
+        x0 = min(int(fx * (w - out_size + 1)), w - out_size)
+        y0 = min(int(fy * (h - out_size + 1)), h - out_size)
     img = img.crop((x0, y0, x0 + out_size, y0 + out_size))
     return np.asarray(img, np.uint8)
 

@@ -39,12 +39,30 @@ def _load() -> ctypes.CDLL:
         lib.tr_tfrecord_split.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int32]
+        if hasattr(lib, "tr_has_jpeg"):  # absent in pre-JPEG .so builds —
+            lib.tr_has_jpeg.restype = ctypes.c_int32  # optional by design
+            lib.tr_has_jpeg.argtypes = []
+            lib.tr_decode_jpeg_vgg.restype = ctypes.c_int32
+            lib.tr_decode_jpeg_vgg.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_float, ctypes.c_float,
+                ctypes.c_void_p]
         _lib = lib
     return _lib
 
 
 def available() -> bool:
     return os.path.exists(_SO_PATH)
+
+
+def jpeg_available() -> bool:
+    """True when the shared library was built with libjpeg."""
+    try:
+        lib = _load() if available() else None
+        return lib is not None and hasattr(lib, "tr_has_jpeg") and \
+            bool(lib.tr_has_jpeg())
+    except ImportError:
+        return False
 
 
 class loader:
@@ -107,3 +125,21 @@ class loader:
         data = buf.tobytes()
         return [data[spans[2 * i]:spans[2 * i] + spans[2 * i + 1]]
                 for i in range(int(n))]
+
+    @staticmethod
+    def decode_jpeg_vgg(jpeg: bytes, resize_side: int, crop: int,
+                        fx: float = -1.0, fy: float = -1.0
+                        ) -> Optional[np.ndarray]:
+        """JPEG → uint8 [crop, crop, 3]: aspect-preserving resize (shorter
+        side = resize_side) + crop. fx/fy in [0,1) pick uniformly among
+        the valid offsets; negative (default) = floor-central crop. GIL
+        released during decode — worker threads scale across cores.
+        Returns None for images this decoder does not handle (caller
+        falls back to PIL)."""
+        lib = _load()
+        out = np.empty((crop, crop, 3), np.uint8)
+        rc = lib.tr_decode_jpeg_vgg(
+            jpeg, len(jpeg), resize_side, crop,
+            ctypes.c_float(fx), ctypes.c_float(fy),
+            out.ctypes.data_as(ctypes.c_void_p))
+        return out if rc == 0 else None

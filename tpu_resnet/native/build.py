@@ -19,9 +19,15 @@ def build(force: bool = False) -> str:
     cxx = shutil.which("g++") or shutil.which("c++")
     if cxx is None:
         raise RuntimeError("no C++ compiler found")
-    cmd = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           SRC, "-o", OUT]
-    subprocess.run(cmd, check=True)
+    base = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            SRC, "-o", OUT]
+    # Prefer the JPEG-enabled build (native VGG decode path); fall back to
+    # record-framing-only when libjpeg headers/libs are absent.
+    with_jpeg = base[:1] + ["-DTR_WITH_JPEG"] + base[1:] + ["-ljpeg"]
+    if subprocess.run(with_jpeg, capture_output=True).returncode != 0:
+        print("libjpeg unavailable; building record-framing-only loader",
+              file=sys.stderr)
+        subprocess.run(base, check=True)
     return OUT
 
 

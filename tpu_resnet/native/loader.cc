@@ -8,11 +8,18 @@
 // Threaded file reads matter here: the host side of the input pipeline is
 // the one part of the framework where Python overhead is measurable.
 
+#include <algorithm>
+#include <cmath>
+#include <csetjmp>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <thread>
 #include <vector>
+
+#ifdef TR_WITH_JPEG
+#include <jpeglib.h>
+#endif
 
 namespace {
 
@@ -151,6 +158,197 @@ int64_t tr_tfrecord_split(const uint8_t* buf, int64_t n, int64_t* out_spans,
     pos = data_off + (int64_t)len + 4;
   }
   return count;
+}
+
+// ------------------------------------------------------ JPEG (VGG host half)
+// The C++ replacement for the reference's tf.image.decode_image + slim VGG
+// resize/crop host work (reference resnet_imagenet_train.py:142-152,
+// vgg_preprocessing.py:259-314). Decode + aspect-preserving bilinear resize
+// (shorter side = resize_side, using libjpeg DCT 1/2^k prescaling when it
+// keeps the shorter side above target) + crop. Called from Python worker
+// threads via ctypes, which releases the GIL — so decode scales across
+// cores where PIL mostly serializes.
+
+int32_t tr_has_jpeg(void) {
+#ifdef TR_WITH_JPEG
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+#ifdef TR_WITH_JPEG
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
+}
+
+// Separable triangle-filter resize (support scaled by the downscale
+// factor — antialiased like PIL's BILINEAR, unlike 2-tap sampling) for
+// RGB uint8.
+struct ResampleAxis {
+  std::vector<int> first;      // per-output-pixel first source index
+  std::vector<int> count;      // taps per output pixel
+  std::vector<float> weights;  // ksize-strided normalized weights
+  int ksize;
+};
+
+void precompute_axis(int in, int out, ResampleAxis& ax) {
+  const double scale = (double)in / out;
+  const double filterscale = std::max(scale, 1.0);
+  const double support = filterscale;  // triangle filter radius 1
+  ax.ksize = (int)std::ceil(support) * 2 + 1;
+  ax.first.resize(out);
+  ax.count.resize(out);
+  ax.weights.assign((size_t)out * ax.ksize, 0.f);
+  for (int i = 0; i < out; i++) {
+    const double center = (i + 0.5) * scale;
+    int xmin = (int)(center - support + 0.5);
+    if (xmin < 0) xmin = 0;
+    int xmax = (int)(center + support + 0.5);
+    if (xmax > in) xmax = in;
+    double total = 0.0;
+    float* w = &ax.weights[(size_t)i * ax.ksize];
+    for (int x = xmin; x < xmax; x++) {
+      double t = std::abs((x + 0.5 - center) / filterscale);
+      double v = t < 1.0 ? 1.0 - t : 0.0;
+      w[x - xmin] = (float)v;
+      total += v;
+    }
+    if (total > 0)
+      for (int k = 0; k < xmax - xmin; k++) w[k] = (float)(w[k] / total);
+    ax.first[i] = xmin;
+    ax.count[i] = xmax - xmin;
+  }
+}
+
+void resize_bilinear(const uint8_t* src, int w, int h, uint8_t* dst, int dw,
+                     int dh) {
+  ResampleAxis hx, vx;
+  precompute_axis(w, dw, hx);
+  precompute_axis(h, dh, vx);
+  // Horizontal pass into a float intermediate (h × dw).
+  std::vector<float> tmp((size_t)h * dw * 3);
+  for (int y = 0; y < h; y++) {
+    const uint8_t* row = src + (size_t)y * w * 3;
+    float* orow = tmp.data() + (size_t)y * dw * 3;
+    for (int x = 0; x < dw; x++) {
+      const float* wt = &hx.weights[(size_t)x * hx.ksize];
+      const uint8_t* p = row + 3 * hx.first[x];
+      float r = 0, g = 0, b = 0;
+      for (int k = 0; k < hx.count[x]; k++, p += 3) {
+        r += wt[k] * p[0];
+        g += wt[k] * p[1];
+        b += wt[k] * p[2];
+      }
+      orow[3 * x] = r;
+      orow[3 * x + 1] = g;
+      orow[3 * x + 2] = b;
+    }
+  }
+  // Vertical pass.
+  for (int y = 0; y < dh; y++) {
+    const float* wt = &vx.weights[(size_t)y * vx.ksize];
+    uint8_t* orow = dst + (size_t)y * dw * 3;
+    for (int x = 0; x < dw * 3; x++) {
+      float v = 0;
+      const float* col = tmp.data() + (size_t)vx.first[y] * dw * 3 + x;
+      for (int k = 0; k < vx.count[y]; k++, col += (size_t)dw * 3)
+        v += wt[k] * *col;
+      orow[x] = (uint8_t)std::min(255.f, std::max(0.f, v + 0.5f));
+    }
+  }
+}
+
+}  // namespace
+#endif  // TR_WITH_JPEG
+
+// JPEG bytes → uint8 RGB [crop, crop, 3] written to out:
+// aspect-preserving resize so the shorter side == resize_side, then a
+// crop. fx/fy in [0,1) map uniformly onto the w-crop+1 valid offsets
+// (each offset equal-weighted, like the reference's uniform random crop,
+// vgg_preprocessing.py:88-168); fx/fy < 0 = floor-central crop
+// ((w-crop)/2, vgg_preprocessing.py:171-193).
+// Returns 0 on success; -1 decode error (caller falls back to PIL),
+// -2 unsupported colorspace, -3 image smaller than the crop, -4 built
+// without libjpeg.
+int32_t tr_decode_jpeg_vgg(const uint8_t* jpeg, int64_t len,
+                           int32_t resize_side, int32_t crop, float fx,
+                           float fy, uint8_t* out) {
+#ifndef TR_WITH_JPEG
+  (void)jpeg; (void)len; (void)resize_side; (void)crop; (void)fx; (void)fy;
+  (void)out;
+  return -4;
+#else
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  std::vector<uint8_t> decoded;
+  if (setjmp(err.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(jpeg), (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  if (cinfo.jpeg_color_space == JCS_CMYK ||
+      cinfo.jpeg_color_space == JCS_YCCK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;  // rare; PIL fallback handles these
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // DCT prescale: biggest 1/2^k that keeps the shorter side >= target.
+  int denom = 1;
+  while (denom < 8 &&
+         (int)std::min(cinfo.image_width, cinfo.image_height) / (denom * 2) >=
+             resize_side)
+    denom *= 2;
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = denom;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width, h = cinfo.output_height;
+  if (w < 1 || h < 1 || cinfo.output_components != 3) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return cinfo.output_components != 3 ? -2 : -3;
+  }
+  decoded.resize((size_t)w * h * 3);
+  while ((int)cinfo.output_scanline < h) {
+    uint8_t* row = decoded.data() + (size_t)cinfo.output_scanline * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+
+  // Aspect-preserving resize: shorter side -> resize_side (round the other,
+  // matching PIL-path semantics in data/imagenet.py::_resize_keep_aspect).
+  const float scale = (float)resize_side / std::min(w, h);
+  const int rw = std::max(1, (int)std::lround(w * scale));
+  const int rh = std::max(1, (int)std::lround(h * scale));
+  std::vector<uint8_t> resized((size_t)rw * rh * 3);
+  resize_bilinear(decoded.data(), w, h, resized.data(), rw, rh);
+
+  if (rw < crop || rh < crop) return -3;
+  const int x0 = fx < 0 ? (rw - crop) / 2
+                        : std::min((int)(fx * (rw - crop + 1)), rw - crop);
+  const int y0 = fy < 0 ? (rh - crop) / 2
+                        : std::min((int)(fy * (rh - crop + 1)), rh - crop);
+  for (int y = 0; y < crop; y++)
+    std::memcpy(out + (size_t)y * crop * 3,
+                resized.data() + ((size_t)(y0 + y) * rw + x0) * 3,
+                (size_t)crop * 3);
+  return 0;
+#endif  // TR_WITH_JPEG
 }
 
 }  // extern "C"
