@@ -83,15 +83,34 @@ def load_cifar(dataset: str, data_dir: str, train: bool,
 
 
 def synthetic_data(num_examples: int, image_size: int = 32,
-                   num_classes: int = 10, seed: int = 0
+                   num_classes: int = 10, seed: int = 0,
+                   learnable: bool = False
                    ) -> Tuple[np.ndarray, np.ndarray]:
-    """Deterministic random images/labels for smoke tests and benchmarks
-    (the role of the reference's batch_size=10 localhost configs,
-    mkl-scripts/run_dist_tf_local.sh:14-21)."""
+    """Deterministic random images for smoke tests and benchmarks (the
+    role of the reference's batch_size=10 localhost configs,
+    mkl-scripts/run_dist_tf_local.sh:14-21).
+
+    ``learnable=True`` derives labels from image content (brightness of a
+    class-dependent patch) instead of random noise, so a working training
+    loop must drive precision well above chance — the test-scale analog
+    of the reference's convergence-curve verification (SURVEY.md §4.4)."""
+    if learnable and num_classes > image_size:
+        raise ValueError(f"learnable synthetic needs num_classes "
+                         f"({num_classes}) <= image_size ({image_size}) "
+                         f"for distinct bands")
     rng = np.random.default_rng(seed)
     images = rng.integers(0, 256, (num_examples, image_size, image_size, 3),
                           dtype=np.uint8)
     labels = rng.integers(0, num_classes, (num_examples,), dtype=np.int32)
+    if learnable:
+        # label = which horizontal band is brightened; a linear probe can
+        # recover it, so any functioning model/optimizer learns it fast.
+        band = max(1, image_size // num_classes)
+        for i, lab in enumerate(labels):
+            y0 = int(lab) * band
+            sl = images[i, y0:y0 + band]
+            images[i, y0:y0 + band] = np.minimum(
+                sl.astype(np.int32) + 120, 255).astype(np.uint8)
     return images, labels
 
 

@@ -142,7 +142,8 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                                 per_replica_bn=per_replica_bn)
         data_iter = build_train_iterator(cfg, mesh, start_step=step)
 
-    meter = ThroughputMeter(cfg.train.global_batch_size)
+    meter = ThroughputMeter(cfg.train.global_batch_size,
+                            num_chips=mesh.size)
     log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
              "global batch %d | input %s", cfg.model.name, cfg.data.dataset,
              total, n_params / 1e6, dict(mesh.shape),

@@ -21,6 +21,8 @@ import os
 import time
 from typing import Dict, Optional
 
+import jax
+
 log = logging.getLogger("tpu_resnet")
 
 
@@ -65,10 +67,13 @@ class MetricsWriter:
 
 
 class ThroughputMeter:
-    """steps/sec + images/sec between log points."""
+    """steps/sec + images/sec (+ per-chip) between log points — the
+    steps/s / images/s/chip comparison axes of the reference's published
+    tables (SURVEY.md §6, README.md:20-51)."""
 
-    def __init__(self, global_batch: int):
+    def __init__(self, global_batch: int, num_chips: int = 0):
         self.global_batch = global_batch
+        self.num_chips = num_chips or jax.device_count()
         self._t = time.perf_counter()
         self._step = None
 
@@ -78,7 +83,9 @@ class ThroughputMeter:
         if self._step is not None and step > self._step and now > self._t:
             sps = (step - self._step) / (now - self._t)
             out = {"steps_per_sec": sps,
-                   "images_per_sec": sps * self.global_batch}
+                   "images_per_sec": sps * self.global_batch,
+                   "images_per_sec_per_chip":
+                       sps * self.global_batch / self.num_chips}
         self._t = now
         self._step = step
         return out
