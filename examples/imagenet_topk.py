@@ -1,0 +1,76 @@
+"""Top-k ImageNet prediction with class names — the runnable equivalent of
+the reference's ``resnet_imagenet_predict.ipynb`` (builds an idx→label map
+from ``data/imagenet1000_clsidx_to_labels.txt`` and prints the top-1 class
+for sample images; SURVEY.md §2.1 Notebooks row).
+
+    python examples/imagenet_topk.py --train-dir /runs/imagenet \
+        --data-dir /data/imagenet --label-file idx_to_labels.txt [--k 5]
+
+The label file uses the same format the reference ships
+(``{0: 'tench, Tinca tinca',`` ...); it is not vendored here — point at
+your own copy.
+"""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--train-dir", required=True)
+    ap.add_argument("--data-dir", required=True)
+    ap.add_argument("--label-file", default="")
+    ap.add_argument("--k", type=int, default=5)
+    ap.add_argument("--num-images", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from tpu_resnet import parallel
+    from tpu_resnet.config import load_config
+    from tpu_resnet.data.imagenet import eval_examples
+    from tpu_resnet.evaluation import build_eval_step
+    from tpu_resnet.models import build_model
+    from tpu_resnet.tools.predict import load_label_map
+    from tpu_resnet.train import build_schedule
+    from tpu_resnet.train.checkpoint import CheckpointManager
+    from tpu_resnet.train.state import init_state
+
+    cfg = load_config("imagenet")
+    cfg.train.train_dir = args.train_dir
+    cfg.data.data_dir = args.data_dir
+    names = load_label_map(cfg, args.label_file)
+
+    mesh = parallel.create_mesh(cfg.mesh)
+    model = build_model(cfg)
+    schedule = build_schedule(cfg.optim, cfg.train)
+    import jax.numpy as jnp
+    template = jax.device_put(
+        init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
+                   jnp.zeros((1, 224, 224, 3))), parallel.replicated(mesh))
+    ckpt = CheckpointManager(cfg.train.train_dir)
+    state = ckpt.restore(template)
+    print(f"restored checkpoint @ step {int(jax.device_get(state.step))}")
+
+    from tpu_resnet.data.augment import get_augment_fns
+    _, eval_pre = get_augment_fns("imagenet")
+
+    @jax.jit
+    def logits_fn(state, images):
+        return model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            eval_pre(images), train=False)
+
+    batch = next(iter(eval_examples(args.data_dir, args.num_images)))
+    images, labels = batch
+    probs = jax.nn.softmax(logits_fn(state, images))
+    top = np.argsort(-np.asarray(probs), axis=-1)[:, :args.k]
+    for i in range(len(images)):
+        truth = names[labels[i]] if labels[i] >= 0 else "?"
+        print(f"\nimage {i} (truth: {truth})")
+        for j, cls in enumerate(top[i]):
+            print(f"  top{j + 1}: {names[cls]:40s} p={float(probs[i, cls]):.3f}")
+
+
+if __name__ == "__main__":
+    main()
