@@ -47,7 +47,7 @@ def main(workdir: str = "/tmp/tpu_resnet_example"):
 
     # 2. Inspect the checkpoint — the tf_saver.py workflow.
     print("\n=== 2. inspect checkpoint ===")
-    inspect_ckpt(train_dir, peek="params/init_conv/kernel")
+    inspect_ckpt(train_dir, peek="params/initial_conv/conv/kernel")
 
     # 3. Freeze → serialized inference artifact (freeze_graph parity).
     print("\n=== 3. export frozen inference artifact ===")

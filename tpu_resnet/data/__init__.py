@@ -43,12 +43,26 @@ def train_batches(data_cfg, local_batch: int, seed: int = 0,
                                start_step=start_step))
 
 
-def eval_split_batches(data_cfg, batch: int):
-    """Full eval-split pass; final batch zero-padded with labels=-1."""
+def eval_split_batches(data_cfg, batch: int,
+                       process_index: int = None, process_count: int = None):
+    """Eval-split pass in batches of ``batch``; short batches zero-padded
+    with labels=-1.
+
+    Multi-process: each process iterates a *disjoint stripe* of the split
+    (record striping for in-memory datasets, shard-file striping for
+    ImageNet — the multi-host fix over the reference's every-node-reads-
+    everything eval, resnet_imagenet_eval.py:83-165). ``batch`` is then the
+    per-process batch; the evaluator assembles the global batch with
+    ``make_array_from_process_local_data`` (pipeline.to_global_arrays)."""
+    import jax
+
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
     if data_cfg.dataset == "imagenet":
         from tpu_resnet.data.imagenet import eval_examples
         return eval_examples(data_cfg.data_dir, batch,
                              num_workers=data_cfg.num_workers,
+                             process_index=pi, process_count=pc,
                              image_size=data_cfg.resolved_image_size)
     images, labels = load_split(data_cfg, train=False)
-    return eval_batches(images, labels, batch)
+    return eval_batches(images[pi::pc], labels[pi::pc], batch)

@@ -40,28 +40,56 @@ log = logging.getLogger("tpu_resnet")
 
 def _mesh_eval_batch(cfg: RunConfig, mesh) -> int:
     """Round the configured eval batch (reference default 100,
-    resnet_cifar_eval.py) up to a multiple of the mesh data axis; padded
-    slots are masked out, so the rounding never changes results."""
+    resnet_cifar_eval.py) up to a multiple of lcm(data axis, process
+    count); padded slots are masked out, so the rounding never changes
+    results."""
+    import math
+
     n_data = mesh.shape["data"]
+    unit = n_data * jax.process_count() // math.gcd(n_data,
+                                                    jax.process_count())
     bs = cfg.train.eval_batch_size
-    return ((bs + n_data - 1) // n_data) * n_data
+    return ((bs + unit - 1) // unit) * unit
 
 
 def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn
                   ) -> Tuple[float, float, int]:
-    """One full pass over the eval split → (precision, mean_loss, count)."""
+    """One full pass over the eval split → (precision, mean_loss, count).
+
+    Multi-host capable (the reference's eval sidecar is single-node,
+    resnet_imagenet_eval.py:83-165): each process streams its own stripe
+    of the split as *local* batches, the global batch is assembled with
+    ``make_array_from_process_local_data``, and the jitted eval step's
+    globally-reduced ``valid`` count doubles as the lockstep termination
+    signal — stripes may differ in length, so an exhausted process keeps
+    feeding all-padding batches, and every process stops after the first
+    round whose global valid count is zero. No cross-host side channel is
+    needed; the mesh collective IS the coordination.
+    """
     import tpu_resnet.data as data_lib
+    from tpu_resnet.data import pipeline
 
     sharding = parallel.batch_sharding(mesh)
+    global_batch = _mesh_eval_batch(cfg, mesh)
+    pc = jax.process_count()
+    local_batch = global_batch // pc
+    size = cfg.data.resolved_image_size
+    pad_img = np.zeros((local_batch, size, size, 3), np.uint8)
+    pad_lab = np.full((local_batch,), -1, np.int32)
+
+    it = iter(data_lib.eval_split_batches(cfg.data, local_batch))
     correct = loss_sum = count = 0
-    for img, lab in data_lib.eval_split_batches(cfg.data,
-                                                _mesh_eval_batch(cfg, mesh)):
-        gi = jax.device_put(img, sharding)
-        gl = jax.device_put(lab, sharding)
+    while True:
+        nxt = next(it, None)
+        img, lab = nxt if nxt is not None else (pad_img, pad_lab)
+        gi, gl = pipeline.to_global_arrays((img, lab), sharding)
         c, ls, n = eval_step_fn(state, gi, gl)
+        n = int(n)  # global valid count — identical on every process
+        if n == 0:
+            break
         correct += int(c)
         loss_sum += float(ls)
-        count += int(n)
+        count += n
     return correct / max(count, 1), loss_sum / max(count, 1), count
 
 

@@ -60,7 +60,7 @@ def list_arrays(train_dir: str, step: Optional[int] = None):
 def restore_raw(train_dir: str, step: Optional[int] = None):
     """Full raw pytree (numpy), shardings dropped — for tooling/debug."""
     step, path = _item_path(train_dir, step)
-    with ocp.PyTreeCheckpointer(restore_concurrent_gb=8) as ckptr:
+    with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(path)
     return step, tree
 
