@@ -94,3 +94,25 @@ def test_files_striped_across_processes(tmp_path):
                                   process_count=2)
     assert set(a.files).isdisjoint(b.files)
     assert len(a.files) + len(b.files) == 4
+
+
+def test_train_stream_resume_continues_exactly(tmp_path):
+    """VERDICT round 1 item 7: a resumed ImageNet run must continue the
+    record stream at the position an uninterrupted run would have reached,
+    not restart from epoch 0. With one worker the batch assembly is
+    deterministic, so label sequences must match batch-for-batch."""
+    import itertools
+
+    make_shards(tmp_path, n_shards=4, per_shard=8, train=True)
+
+    def batches(start_step, n):
+        it = iter(imagenet.ImageNetIterator(
+            str(tmp_path), local_batch=4, train=True, num_workers=1,
+            shuffle_buffer=8, seed=3, start_step=start_step))
+        return [lab.tolist() for _, lab in itertools.islice(it, n)]
+
+    full = batches(0, 6)          # steps 0..5 uninterrupted
+    resumed = batches(3, 3)       # restart "after step 3"
+    assert resumed == full[3:6]
+    # and the resumed stream is genuinely shuffled/advanced, not epoch 0
+    assert resumed != full[0:3]

@@ -166,13 +166,15 @@ class ImageNetIterator:
         self.resize_max = resize_max
         self.image_size = image_size
         self.start_step = start_step
+        self._findex: dict = {}
+        self._read_f = None
+        self._read_path = None
 
     def _records(self) -> Iterator[Tuple[bytes, int]]:
         epoch = 0
         while True:
-            files = list(self.files)
-            if self.train:
-                np.random.default_rng((self.seed, epoch)).shuffle(files)
+            files = (self._epoch_files(epoch) if self.train
+                     else list(self.files))
             for f in files:
                 for rec in read_shard_records(f):
                     yield rec
@@ -180,15 +182,40 @@ class ImageNetIterator:
                 return
             epoch += 1
 
-    def _shuffled_records(self) -> Iterator[bytes]:
+    # -------------------------------------------------- resume fast-forward
+    def _file_index(self, path: str):
+        """Cached seek-only (offset, length) index of one shard."""
+        if path not in self._findex:
+            self._findex[path] = tfrecord.record_index(path)
+        return self._findex[path]
+
+    def _epoch_files(self, epoch: int) -> List[str]:
+        """Per-epoch shard order — pure function of (seed, epoch), shared
+        by ``_records`` and the resume fast-forward."""
+        files = list(self.files)
+        np.random.default_rng((self.seed, epoch)).shuffle(files)
+        return files
+
+    def _read_at(self, path: str, idx: int) -> bytes:
+        """Random-access one record payload (sequential in practice: the
+        position stream visits files in order, so this keeps one shard
+        open and seeks forward within it)."""
+        if self._read_path != path:
+            if self._read_f is not None:
+                self._read_f.close()
+            self._read_f = open(path, "rb")
+            self._read_path = path
+        off, length = self._file_index(path)[idx]
+        self._read_f.seek(off)
+        return self._read_f.read(length)
+
+    def _shuffle_stream(self, records: Iterator[bytes],
+                        rng: np.random.Generator,
+                        buf: List[bytes]) -> Iterator[bytes]:
         """Reservoir-style shuffle buffer (the reference's
-        ``shuffle(buffer_size=1024)``, resnet_imagenet_train.py:174-178)."""
-        rng = np.random.default_rng((self.seed, 1))
-        buf: List[bytes] = []
-        for rec in self._records():
-            if not self.train:
-                yield rec
-                continue
+        ``shuffle(buffer_size=1024)``, resnet_imagenet_train.py:174-178),
+        resumable: ``rng`` and ``buf`` carry the mid-stream state."""
+        for rec in records:
             buf.append(rec)
             if len(buf) >= self.shuffle_buffer:
                 idx = int(rng.integers(0, len(buf)))
@@ -198,6 +225,66 @@ class ImageNetIterator:
             idx = int(rng.integers(0, len(buf)))
             buf[idx], buf[-1] = buf[-1], buf[idx]
             yield buf.pop()
+
+    def _shuffled_records(self) -> Iterator[bytes]:
+        """Shuffled record stream; with ``start_step > 0`` it continues
+        *exactly* where an uninterrupted run's stream would be after
+        ``start_step`` batches (reference resume contract,
+        resnet_imagenet_train.py:267-270 — which the reference itself does
+        not honor for the input stream).
+
+        Fast-forward replays the shuffle-buffer algorithm over cheap
+        (file, record#) positions — identical RNG draws, no payload reads —
+        reconstructing the buffer contents and RNG state at the resume
+        point; only the ≤ ``shuffle_buffer`` records still in the buffer
+        are then fetched via the seek-only shard index."""
+        if not self.train:
+            yield from self._records()
+            return
+        rng = np.random.default_rng((self.seed, 1))
+        if self.start_step <= 0:
+            yield from self._shuffle_stream(self._records(), rng, [])
+            return
+        skip = self.start_step * self.local_batch
+        # Explicit (epoch, file#, record#) cursor through the position
+        # stream, so the continuation below can resume with *bulk* shard
+        # reads — only the <= shuffle_buffer records reconstructed into the
+        # buffer (and the tail of the one partially-consumed shard) use
+        # indexed random access.
+        epoch, fi, ri = 0, 0, 0
+        files = self._epoch_files(0)
+        pos_buf: List[Tuple[str, int]] = []
+        emitted = 0
+        while emitted < skip:  # train stream is infinite → never drains
+            while ri >= len(self._file_index(files[fi])):
+                fi, ri = fi + 1, 0
+                if fi >= len(files):
+                    epoch, fi = epoch + 1, 0
+                    files = self._epoch_files(epoch)
+            pos_buf.append((files[fi], ri))
+            ri += 1
+            if len(pos_buf) >= self.shuffle_buffer:
+                idx = int(rng.integers(0, len(pos_buf)))
+                pos_buf[idx], pos_buf[-1] = pos_buf[-1], pos_buf[idx]
+                pos_buf.pop()
+                emitted += 1
+        buf = [self._read_at(f, i) for f, i in pos_buf]
+
+        def rest() -> Iterator[bytes]:
+            e, f0, r0 = epoch, fi, ri
+            while True:
+                efiles = self._epoch_files(e) if e != epoch else files
+                for k in range(f0, len(efiles)):
+                    if r0:  # tail of the partially-consumed shard
+                        index = self._file_index(efiles[k])
+                        for i in range(r0, len(index)):
+                            yield self._read_at(efiles[k], i)
+                        r0 = 0
+                    else:  # whole shards go through the bulk reader
+                        yield from read_shard_records(efiles[k])
+                e, f0 = e + 1, 0
+
+        yield from self._shuffle_stream(rest(), rng, buf)
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         if Image is None:
