@@ -1,0 +1,81 @@
+"""Launcher-topology tests: run the sbatch generator in dry-run mode with a
+stubbed `scontrol`/`srun` and assert the process-id mapping — the testable
+core of the reference's Slurm generators (mkl-scripts/run_dist_tf_daint.sh
+assembles hostlists and generates per-node scripts; SURVEY.md §2.2)."""
+
+import os
+import re
+import stat
+import subprocess
+
+
+def _run_sbatch(tmp_path, nodes, env_extra):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    scontrol = bindir / "scontrol"
+    scontrol.write_text("#!/usr/bin/env bash\n"
+                        + "".join(f"echo {n}\n" for n in nodes))
+    scontrol.chmod(scontrol.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["SLURM_JOB_NODELIST"] = "stub[0-99]"  # consumed by the stub
+    env["SLURM_JOB_ID"] = "4242"
+    env["TPU_SBATCH_DRYRUN"] = "1"
+    env["LOGDIR"] = str(tmp_path / "logs")
+    env.update(env_extra)
+    proc = subprocess.run(
+        ["bash", os.path.join(repo, "launch", "slurm_train_eval.sbatch"),
+         "--preset", "imagenet", "train.train_dir=/scratch/run 1"],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout
+    return proc.stdout, tmp_path / "logs"
+
+
+def _rank_map(logdir):
+    """{global process id: (node, local_rank)} parsed from the generated
+    per-node scripts."""
+    out = {}
+    for script in sorted(logdir.glob("node.*.sh")):
+        node = script.name.split(".")[2]
+        for line in script.read_text().splitlines():
+            m = re.search(r"TPU_PROCESS_ID=(\d+) TPU_PROCS_PER_NODE=\d+ "
+                          r"TPU_LOCAL_RANK=(\d+)", line)
+            if m:
+                assert "TPU_NUM_PROCESSES" in line
+                out[int(m.group(1))] = (node, int(m.group(2)))
+    return out
+
+
+def test_four_host_two_procs_per_node(tmp_path):
+    """v4-32-style topology: 4 hosts x 2 processes + a dedicated eval node
+    — the configuration the round-1 launcher could not express."""
+    nodes = [f"nid{i:04d}" for i in range(5)]
+    out, logdir = _run_sbatch(tmp_path, nodes,
+                              {"TPU_PROCS_PER_NODE": "2"})
+    ranks = _rank_map(logdir)
+    assert sorted(ranks) == list(range(8))  # 4 train nodes x 2, gapless
+    for pid, (node, local) in ranks.items():
+        assert node == f"nid{pid // 2:04d}"
+        assert local == pid % 2
+    # every process sees the same world size and coordinator, and args
+    # with spaces survive the generated-script round trip shell-quoted
+    for script in logdir.glob("node.*.sh"):
+        text = script.read_text()
+        assert text.count("TPU_NUM_PROCESSES=8") == 2
+        assert "nid0000:29400" in text
+        assert r"/scratch/run\ 1" in text
+    assert "eval node nid0004" in out
+
+
+def test_colocated_eval_single_proc(tmp_path):
+    """TF_PS_IN_WORKER analog: eval shares the last train node."""
+    nodes = [f"host{i}" for i in range(3)]
+    out, logdir = _run_sbatch(tmp_path, nodes,
+                              {"TPU_EVAL_MODE": "colocated"})
+    ranks = _rank_map(logdir)
+    assert sorted(ranks) == [0, 1, 2]  # all 3 nodes train
+    last = (logdir / "node.4242.host2.sh").read_text()
+    assert "tpu_resnet eval" in last
+    assert "eval node" not in out  # no dedicated eval srun

@@ -48,10 +48,29 @@ def initialize(coordinator_address: Optional[str] = None,
         log.info("single-process run; skipping jax.distributed.initialize")
         return
 
+    kwargs = {}
+    # >1 process per node (launch/slurm_train_eval.sbatch
+    # TPU_PROCS_PER_NODE): each process must claim a disjoint chip subset,
+    # or all colocated processes fight over the same local devices. The
+    # launcher exports the node-local rank; chips/node defaults to 4 (one
+    # TPU-VM host) and is overridable via TPU_CHIPS_PER_NODE.
+    procs_per_node = int(os.environ.get("TPU_PROCS_PER_NODE", "1"))
+    if procs_per_node > 1 and "TPU_LOCAL_RANK" in os.environ:
+        local_rank = int(os.environ["TPU_LOCAL_RANK"])
+        chips = int(os.environ.get("TPU_CHIPS_PER_NODE", "4"))
+        per_proc = chips // procs_per_node
+        if per_proc < 1:
+            raise ValueError(
+                f"TPU_PROCS_PER_NODE={procs_per_node} exceeds "
+                f"TPU_CHIPS_PER_NODE={chips}")
+        kwargs["local_device_ids"] = list(
+            range(local_rank * per_proc, (local_rank + 1) * per_proc))
+
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
     log.info("multi-host initialized: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
