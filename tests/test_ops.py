@@ -65,3 +65,36 @@ def test_under_jit_and_grad_composes():
     val, grad = jax.value_and_grad(f)(logits)
     assert np.isfinite(float(val))
     assert grad.shape == logits.shape
+
+
+def test_shard_map_per_example_over_data_axis():
+    """The auto-sharded-jit integration (train/step.py): the per-example
+    kernel shard_mapped over the batch axis must match the reference and
+    differentiate correctly — this is the path that makes the Pallas xent
+    reachable in the default multi-chip config (VERDICT round 1 item 6)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_resnet import parallel
+
+    mesh = parallel.create_mesh(None)
+    rng = np.random.default_rng(3)
+    b, c = 32, 100
+    logits = jnp.asarray(rng.normal(size=(b, c)) * 3, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, c, b), jnp.int32)
+
+    def mean_xent(lg):
+        per_ex = shard_map(
+            lambda l, y: softmax_xent_per_example(l, y, interpret=True),
+            mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data"), check_vma=False)(lg, labels)
+        return jnp.mean(per_ex)
+
+    got = jax.jit(mean_xent)(logits)
+    want = _reference_per_example(logits, labels, c).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    g_got = jax.jit(jax.grad(mean_xent))(logits)
+    g_want = jax.grad(
+        lambda x: _reference_per_example(x, labels, c).mean())(logits)
+    np.testing.assert_allclose(g_got, g_want, rtol=1e-5, atol=1e-6)
