@@ -3,27 +3,73 @@
 Headline metric: ResNet-50 CIFAR-10 training steps/sec at global batch 128
 on the available chips — directly comparable to the reference's published
 'local' number: 13.94 steps/s, README.md:28 (BASELINE.md row 1), which is
-``vs_baseline``'s denominator.
+``vs_baseline``'s denominator. A second entry times the ImageNet-shaped
+workload (ResNet-50 @ 224x224, batch 128, bf16) against the reference's
+single-node 1ps-1wk b128 line (0.96 steps/s, README.md:48) and reports MFU
+(measured train-step FLOPs over the chip's peak).
 
 The measured step is the full training step: on-device augmentation
 (pad/crop/flip/standardize), bf16 forward/backward, L2-in-loss, momentum
 update, BN stats update — i.e. what the reference's
 ``mon_sess.run(train_op)`` covered (resnet_cifar_train.py:343-344), input
-included. The input edge is the framework's device-resident path
+included. The CIFAR input edge is the framework's device-resident path
 (tpu_resnet/data/device_data.py): the training split lives in HBM, batches
 are cut on-device, and ``train.steps_per_call`` steps run per dispatch —
 the same configuration a real CIFAR training run uses by default.
-CIFAR-shaped synthetic data is used so the benchmark needs no dataset
-download; the compute path is identical.
+Synthetic data is used so the benchmark needs no dataset download; the
+compute path is identical.
+
+Robustness (round-1 postmortem: the TPU plugin hung/failed and the bench
+died with a raw traceback and no JSON): the parent process never imports
+jax. It probes the TPU backend in a short-timeout subprocess, retries with
+backoff, runs the measurement in a child process, and on unrecoverable TPU
+failure falls back to a small CPU measurement clearly labeled
+``"backend": "cpu"`` — emitting exactly one JSON line in every case.
+
+    python bench.py                 # orchestrate (the driver's entry)
+    python bench.py --child tpu     # measurement child, ambient backend
+    python bench.py --child cpu     # measurement child, reduced counts
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-BASELINE_STEPS_PER_SEC = 13.94  # reference README.md:28
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_CIFAR_SPS = 13.94     # reference README.md:28 (local b128)
+BASELINE_IMAGENET_SPS = 0.96   # reference README.md:48 (1ps-1wk b128)
+
+HEADLINE_METRIC = "cifar10_resnet50_train_steps_per_sec_b128"
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
+# Order matters: check the more specific names first.
+_PEAK_FLOPS = [
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+]
 
 
-def main():
+def _peak_flops(device_kind: str):
+    env = os.environ.get("BENCH_PEAK_FLOPS")
+    if env:
+        return float(env)
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# measurement children (import jax; run under the parent's timeouts)
+# --------------------------------------------------------------------------
+
+def _measure_cifar(mesh, warmup_chunks, measure_chunks, steps_per_call):
     import jax
     import jax.numpy as jnp
 
@@ -41,9 +87,8 @@ def main():
     cfg.train.global_batch_size = 128
     cfg.model.resnet_size = 50
     cfg.model.compute_dtype = "bfloat16"
-    k = cfg.train.steps_per_call  # 10: fused steps per dispatch
+    k = steps_per_call
 
-    mesh = parallel.create_mesh(cfg.mesh)
     model = build_model(cfg)
     sched = build_schedule(cfg.optim, cfg.train)
     rng = jax.random.PRNGKey(0)
@@ -60,7 +105,6 @@ def main():
         make_train_step(model, cfg.optim, sched, 10, augment_fn,
                         base_rng=rng, mesh=mesh), ds, mesh, k)
 
-    warmup_chunks, measure_chunks = 4, 30
     step = 0
     for _ in range(warmup_chunks):
         state, metrics = run_chunk(state, step, k)
@@ -73,15 +117,290 @@ def main():
         step += k
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
+    return measure_chunks * k / dt
 
-    sps = measure_chunks * k / dt
-    print(json.dumps({
-        "metric": "cifar10_resnet50_train_steps_per_sec_b128",
-        "value": round(sps, 2),
+
+def _train_step_flops(compiled):
+    """Per-step, per-device FLOPs from XLA's compiled cost analysis (the
+    post-SPMD module is per-device); None if the backend doesn't report
+    them."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = (cost or {}).get("flops")
+        if flops and flops > 0:
+            return float(flops)
+    except Exception:
+        pass
+    return None
+
+
+def _measure_imagenet(mesh, warmup_steps, measure_steps):
+    """ImageNet-shaped training step: ResNet-50 @ 224, batch 128, bf16,
+    synthetic pre-processed input resident on device. Returns
+    (steps/s, flops_per_step or None)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_resnet.config import load_config
+    from tpu_resnet import parallel
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import build_schedule, init_state
+    from tpu_resnet.train.step import make_train_step, shard_step
+
+    cfg = load_config("imagenet")
+    cfg.train.global_batch_size = 128
+    cfg.model.resnet_size = 50
+    cfg.model.compute_dtype = "bfloat16"
+
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    rng = jax.random.PRNGKey(0)
+    state = init_state(model, cfg.optim, sched, rng,
+                       jnp.zeros((1, 224, 224, 3)))
+    state = jax.device_put(state, parallel.replicated(mesh))
+
+    # Pre-processed (VGG mean-subtracted) float input, as the host pipeline
+    # would deliver it; one resident batch re-fed each step so the
+    # measurement isolates the training step itself.
+    bs = parallel.batch_sharding(mesh)
+    images = jax.device_put(
+        np.random.RandomState(0)
+        .uniform(-114.0, 141.0, (128, 224, 224, 3)).astype(np.float32), bs)
+    labels = jax.device_put(
+        np.random.RandomState(1).randint(0, 1000, 128).astype(np.int32), bs)
+
+    step_fn = shard_step(
+        make_train_step(model, cfg.optim, sched, 1000, None,
+                        base_rng=rng, mesh=mesh), mesh, donate_state=False)
+    compiled = step_fn.lower(state, images, labels).compile()
+    flops = _train_step_flops(compiled)
+
+    for _ in range(warmup_steps):
+        state, metrics = compiled(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(measure_steps):
+        state, metrics = compiled(state, images, labels)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return measure_steps / dt, flops
+
+
+def _measure_pallas_ab(iters=100):
+    """A/B the Pallas fused softmax-xent (fwd+bwd) against the XLA/optax
+    chain at b128x10 and b128x1000 (VERDICT round 1 item 6)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet.ops import softmax_xent_mean
+    from tpu_resnet.train.step import softmax_xent
+
+    out = {}
+    for classes in (10, 1000):
+        rng = jax.random.PRNGKey(classes)
+        logits = jax.random.normal(rng, (128, classes), jnp.float32)
+        labels = jax.random.randint(jax.random.PRNGKey(1), (128,), 0, classes)
+
+        def time_fn(fn):
+            g = jax.jit(jax.grad(lambda x: fn(x)))
+            g(logits).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = g(logits)
+            r.block_until_ready()
+            return (time.perf_counter() - t0) / iters * 1e6  # us
+
+        pallas_us = time_fn(lambda x: softmax_xent_mean(x, labels))
+        xla_us = time_fn(lambda x: softmax_xent(x, labels, classes))
+        out[f"b128x{classes}"] = {
+            "pallas_us": round(pallas_us, 2), "xla_us": round(xla_us, 2),
+            "speedup": round(xla_us / pallas_us, 3)}
+    return out
+
+
+def run_child(kind: str) -> None:
+    """Run the measurements on the ambient backend; final stdout line is
+    ``RESULT_JSON: {...}`` for the parent. Progress goes to stderr."""
+    import jax
+
+    from tpu_resnet import parallel
+
+    devices = jax.devices()
+    kinds = devices[0].device_kind
+    print(f"[bench child] backend={jax.default_backend()} "
+          f"devices={len(devices)} kind={kinds}", file=sys.stderr)
+    if kind == "tpu" and devices[0].platform == "cpu":
+        raise RuntimeError("TPU child got a CPU backend — refusing to run "
+                           "TPU-scale measurement counts on CPU")
+    mesh = parallel.create_mesh(None)
+
+    result = {"backend": jax.default_backend(), "device_kind": kinds,
+              "n_devices": len(devices)}
+    errors = {}
+
+    if kind == "cpu":
+        # Reduced counts: the CPU number is a liveness fallback, not a
+        # performance claim.
+        sps = _measure_cifar(mesh, warmup_chunks=1, measure_chunks=2,
+                             steps_per_call=2)
+    else:
+        sps = _measure_cifar(mesh, warmup_chunks=4, measure_chunks=30,
+                             steps_per_call=10)
+    result["cifar"] = {"steps_per_sec": round(sps, 2)}
+    print(f"[bench child] cifar: {sps:.2f} steps/s", file=sys.stderr)
+
+    if kind == "tpu":
+        try:
+            inet_sps, flops = _measure_imagenet(mesh, warmup_steps=5,
+                                                measure_steps=30)
+            entry = {
+                "metric": "imagenet_resnet50_train_steps_per_sec_b128",
+                "value": round(inet_sps, 3), "unit": "steps/sec",
+                "vs_baseline": round(inet_sps / BASELINE_IMAGENET_SPS, 2),
+                "images_per_sec": round(inet_sps * 128, 1),
+            }
+            peak = _peak_flops(kinds)
+            if flops:
+                entry["flops_per_step_per_device"] = flops
+                entry["flops_source"] = "xla_cost_analysis"
+            else:
+                # Analytic: ResNet-50@224 fwd ~= 4.09 GF/img; train ~= 3x;
+                # normalized per device like the cost-analysis branch.
+                entry["flops_per_step_per_device"] = (
+                    3 * 4.09e9 * 128 / len(devices))
+                entry["flops_source"] = "analytic"
+            if peak:
+                # peak is per chip, flops are per device → MFU per chip.
+                entry["mfu"] = round(
+                    entry["flops_per_step_per_device"] * inet_sps / peak, 4)
+                entry["peak_flops_assumed_per_chip"] = peak
+            result["imagenet"] = entry
+            print(f"[bench child] imagenet: {inet_sps:.3f} steps/s "
+                  f"mfu={entry.get('mfu')}", file=sys.stderr)
+        except Exception as e:
+            errors["imagenet"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            result["pallas_xent_ab"] = _measure_pallas_ab()
+            print(f"[bench child] pallas A/B: {result['pallas_xent_ab']}",
+                  file=sys.stderr)
+        except Exception as e:
+            errors["pallas_xent_ab"] = f"{type(e).__name__}: {e}"[:500]
+
+    if errors:
+        result["errors"] = errors
+    print("RESULT_JSON: " + json.dumps(result), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _run(cmd, env, timeout):
+    try:
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out + f"\n[parent] timeout after {timeout}s"
+
+
+def _probe_tpu(timeout):
+    """Can the ambient backend initialize at all? Short-timeout subprocess
+    so a hanging PJRT plugin costs seconds, not the driver's budget."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', len(d), '|', d[0].device_kind, '|', "
+            "d[0].platform, jax.default_backend())")
+    rc, out = _run([sys.executable, "-c", code], dict(os.environ), timeout)
+    last = out.strip().splitlines()[-1] if out.strip() else f"rc={rc}"
+    # A silent CPU fallback must not pass as "TPU available" — the
+    # TPU-scale child would burn its whole timeout on CPU. Accept only a
+    # non-cpu accelerator backend (tpu, or a PJRT plugin name like 'axon').
+    ok = (rc == 0 and "PROBE_OK" in last
+          and " cpu" not in last.rsplit("|", 1)[-1])
+    return ok, last
+
+
+def _parse_result(out: str):
+    for line in reversed(out.splitlines()):
+        if line.startswith("RESULT_JSON: "):
+            return json.loads(line[len("RESULT_JSON: "):])
+    return None
+
+
+def _emit(result: dict, cifar_sps, extra=None):
+    """Print the single driver-facing JSON line (headline = CIFAR)."""
+    line = {
+        "metric": HEADLINE_METRIC,
+        "value": round(cifar_sps, 2) if cifar_sps else None,
         "unit": "steps/sec",
-        "vs_baseline": round(sps / BASELINE_STEPS_PER_SEC, 2),
-    }))
+        "vs_baseline": (round(cifar_sps / BASELINE_CIFAR_SPS, 2)
+                        if cifar_sps else None),
+    }
+    line.update(result)
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def main():
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "1200"))
+    backoffs = [20, 60, 120]
+    diags = []
+
+    me = os.path.abspath(__file__)
+    for attempt in range(attempts):
+        if attempt:
+            delay = backoffs[min(attempt - 1, len(backoffs) - 1)]
+            print(f"[bench] retrying TPU in {delay}s", file=sys.stderr)
+            time.sleep(delay)
+        ok, diag = _probe_tpu(probe_timeout)
+        diags.append(f"probe{attempt}: {diag}")
+        print(f"[bench] TPU probe attempt {attempt}: "
+              f"{'ok' if ok else 'FAILED'} ({diag})", file=sys.stderr)
+        if not ok:
+            continue
+        rc, out = _run([sys.executable, me, "--child", "tpu"],
+                       dict(os.environ), child_timeout)
+        sys.stderr.write(out)
+        result = _parse_result(out)
+        if rc == 0 and result:
+            cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
+            _emit(result, cifar_sps)
+            return 0
+        diags.append(f"child{attempt}: rc={rc}, tail="
+                     + " | ".join(out.strip().splitlines()[-3:]))
+
+    # Unrecoverable TPU failure: labeled CPU fallback so the round still
+    # records a live number plus the TPU diagnostics.
+    print("[bench] TPU unavailable — CPU fallback", file=sys.stderr)
+    from __graft_entry__ import _cpu_env
+    rc, out = _run([sys.executable, me, "--child", "cpu"], _cpu_env(1),
+                   max(600, child_timeout // 2))
+    sys.stderr.write(out)
+    result = _parse_result(out)
+    if rc == 0 and result:
+        cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
+        _emit(result, cifar_sps, extra={"tpu_error": "; ".join(diags)})
+        return 0
+    diags.append(f"cpu child: rc={rc}, tail="
+                 + " | ".join(out.strip().splitlines()[-3:]))
+    _emit({"backend": "none", "error": "; ".join(diags)[:2000]}, None)
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        run_child(sys.argv[2])
+    else:
+        sys.exit(main())
