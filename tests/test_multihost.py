@@ -17,6 +17,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 WORKER = r"""
 import os, sys, json
 import jax
@@ -127,6 +129,7 @@ def _run_two_process(script, tmp_path):
     return [json.loads(o.strip().splitlines()[-1]) for o in outs]
 
 
+@pytest.mark.slow
 def test_two_process_data_parallel(tmp_path):
     results = _run_two_process(WORKER, tmp_path)
     assert {r["process"] for r in results} == {0, 1}
@@ -135,6 +138,7 @@ def test_two_process_data_parallel(tmp_path):
     assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
 
 
+@pytest.mark.slow
 def test_two_process_eval_pass(tmp_path):
     """Standalone multi-host eval (VERDICT round 1 item 4): both processes
     stream disjoint stripes, agree on the global precision, and count every

@@ -30,6 +30,10 @@ class DataConfig:
 
     dataset: str = "cifar10"  # cifar10 | cifar100 | imagenet | synthetic
     data_dir: str = ""
+    # synthetic only: derive labels from image content (a brightened band)
+    # so training must genuinely learn — the no-download stand-in for
+    # real-data convergence runs (data/cifar.py::synthetic_data).
+    synthetic_learnable: bool = False
     # Number of worker threads in the host loader (reference uses 16 queue
     # threads, cifar_input.py:99-100; and num_parallel_calls=4 tf.data maps).
     num_workers: int = 4

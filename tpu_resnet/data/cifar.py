@@ -123,5 +123,7 @@ def load_split(cfg, train: bool) -> Tuple[np.ndarray, np.ndarray]:
     if cfg.dataset == "synthetic":
         n = cfg.train_examples if train else cfg.eval_examples
         return synthetic_data(n, cfg.resolved_image_size, cfg.num_classes,
-                              seed=0 if train else 1)
+                              seed=0 if train else 1,
+                              learnable=getattr(cfg, "synthetic_learnable",
+                                                False))
     raise ValueError(f"load_split does not handle {cfg.dataset!r}")

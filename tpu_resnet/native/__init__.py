@@ -52,6 +52,28 @@ def _load() -> ctypes.CDLL:
 
 
 def available() -> bool:
+    if os.path.exists(_SO_PATH):
+        return True
+    return _autobuild()
+
+
+_AUTOBUILD_TRIED = False
+
+
+def _autobuild() -> bool:
+    """One-shot lazy build of the shared library (the binary is a build
+    artifact, never vendored in git). Opt out with
+    ``TPU_RESNET_NATIVE_AUTOBUILD=0``; failures fall back to numpy."""
+    global _AUTOBUILD_TRIED
+    if _AUTOBUILD_TRIED or os.environ.get(
+            "TPU_RESNET_NATIVE_AUTOBUILD", "1") == "0":
+        return os.path.exists(_SO_PATH)
+    _AUTOBUILD_TRIED = True
+    try:
+        from tpu_resnet.native.build import build
+        build()
+    except Exception:
+        return False
     return os.path.exists(_SO_PATH)
 
 

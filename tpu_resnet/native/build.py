@@ -19,15 +19,25 @@ def build(force: bool = False) -> str:
     cxx = shutil.which("g++") or shutil.which("c++")
     if cxx is None:
         raise RuntimeError("no C++ compiler found")
+    # Compile to a private temp path and os.replace into place: concurrent
+    # first-use builders (e.g. every process of a multi-node run on a
+    # shared filesystem) each produce a complete .so and atomically win or
+    # lose the rename — readers never dlopen a half-written file.
+    tmp = f"{OUT}.tmp.{os.getpid()}"
     base = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            SRC, "-o", OUT]
+            SRC, "-o", tmp]
     # Prefer the JPEG-enabled build (native VGG decode path); fall back to
     # record-framing-only when libjpeg headers/libs are absent.
     with_jpeg = base[:1] + ["-DTR_WITH_JPEG"] + base[1:] + ["-ljpeg"]
-    if subprocess.run(with_jpeg, capture_output=True).returncode != 0:
-        print("libjpeg unavailable; building record-framing-only loader",
-              file=sys.stderr)
-        subprocess.run(base, check=True)
+    try:
+        if subprocess.run(with_jpeg, capture_output=True).returncode != 0:
+            print("libjpeg unavailable; building record-framing-only loader",
+                  file=sys.stderr)
+            subprocess.run(base, check=True)
+        os.replace(tmp, OUT)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     return OUT
 
 
