@@ -124,6 +124,5 @@ def load_split(cfg, train: bool) -> Tuple[np.ndarray, np.ndarray]:
         n = cfg.train_examples if train else cfg.eval_examples
         return synthetic_data(n, cfg.resolved_image_size, cfg.num_classes,
                               seed=0 if train else 1,
-                              learnable=getattr(cfg, "synthetic_learnable",
-                                                False))
+                              learnable=cfg.synthetic_learnable)
     raise ValueError(f"load_split does not handle {cfg.dataset!r}")

@@ -36,6 +36,7 @@ def main(argv=None):
         ("export", "freeze a checkpoint into a serialized inference artifact"),
         ("predict", "run a frozen artifact over the eval split"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
+        ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
     ]:
         p = sub.add_parser(name, help=help_text)
         p.add_argument("--preset", default="")
@@ -61,6 +62,11 @@ def main(argv=None):
             p.add_argument("--step", type=int, default=None)
             p.add_argument("--peek", default=None,
                            help="print stats+head of one array by path")
+        if name == "plot":
+            p.add_argument("--dir", required=True, help="train dir")
+            p.add_argument("--out", default=None, help="output PNG path")
+            p.add_argument("--csv", default=None,
+                           help="also export merged series as CSV")
     args = parser.parse_args(argv)
 
     from tpu_resnet.config import load_config
@@ -111,6 +117,12 @@ def main(argv=None):
     if args.command == "inspect":
         from tpu_resnet.tools.inspect_ckpt import main as inspect_main
         inspect_main(args.dir, step=args.step, peek=args.peek)
+        return 0
+
+    if args.command == "plot":
+        from tpu_resnet.tools.plot_metrics import plot
+        out = plot(args.dir, out=args.out, csv_out=args.csv)
+        print(f"wrote {out}")
         return 0
 
     parser.error(f"unknown command {args.command}")

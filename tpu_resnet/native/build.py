@@ -23,7 +23,7 @@ def build(force: bool = False) -> str:
     # first-use builders (e.g. every process of a multi-node run on a
     # shared filesystem) each produce a complete .so and atomically win or
     # lose the rename — readers never dlopen a half-written file.
-    tmp = f"{OUT}.tmp.{os.getpid()}"
+    tmp = f"{OUT}.tmp.{os.uname().nodename}.{os.getpid()}"
     base = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
             SRC, "-o", tmp]
     # Prefer the JPEG-enabled build (native VGG decode path); fall back to

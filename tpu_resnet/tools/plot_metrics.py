@@ -1,0 +1,119 @@
+"""Training/eval-curve plots from metrics.jsonl — the results-artifact
+role of the reference's experiment bookkeeping (reference
+`results/cifar10.jpeg` linked from README.md:34 shows the eval Precision /
+Best_Precision curves; `ps1workers1.csv` collects run series).
+
+    python -m tpu_resnet plot --dir /tmp/run1 --out /tmp/run1/curves.png
+
+Reads ``<dir>/metrics.jsonl`` (train series: loss/precision/lr/steps_per_sec,
+written by train/metrics_io.py) and, when present,
+``<dir>/eval/metrics.jsonl`` (Precision/Best_Precision vs restored step from
+the eval sidecar) and renders one PNG. Also exports the merged series as CSV
+with ``--csv`` (the ps1workers1.csv role).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+
+def load_series(path: str) -> List[dict]:
+    """metrics.jsonl → list of records (torn tail lines skipped, matching
+    evaluation/evaluator.py::_last_eval's tolerance)."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "step" in rec:
+                out.append(rec)
+    return out
+
+
+def _column(series: List[dict], key: str):
+    xs = [r["step"] for r in series if key in r]
+    ys = [r[key] for r in series if key in r]
+    return xs, ys
+
+
+def write_csv(train: List[dict], evals: List[dict], path: str) -> None:
+    import csv
+
+    keys: List[str] = ["step"]
+    for rec in train + evals:
+        for k in rec:
+            if k not in keys and k != "wall":
+                keys.append(k)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["series"] + keys,
+                           extrasaction="ignore")
+        w.writeheader()
+        for rec in train:
+            w.writerow({"series": "train", **rec})
+        for rec in evals:
+            w.writerow({"series": "eval", **rec})
+
+
+def plot(train_dir: str, out: Optional[str] = None,
+         csv_out: Optional[str] = None) -> str:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    train = load_series(os.path.join(train_dir, "metrics.jsonl"))
+    evals = load_series(os.path.join(train_dir, "eval", "metrics.jsonl"))
+    if not train and not evals:
+        raise FileNotFoundError(f"no metrics.jsonl under {train_dir}")
+    out = out or os.path.join(train_dir, "curves.png")
+    if csv_out:
+        write_csv(train, evals, csv_out)
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    ax = axes[0]
+    for key, label in [("precision", "train precision"),
+                       ("Precision", None)]:
+        src = train if key == "precision" else evals
+        xs, ys = _column(src, key)
+        if xs:
+            ax.plot(xs, ys, label=label or "eval Precision", marker="o"
+                    if src is evals else None, markersize=3)
+    xs, ys = _column(evals, "Best_Precision")
+    if xs:
+        ax.plot(xs, ys, label="eval Best_Precision", linestyle="--")
+    ax.set_xlabel("step")
+    ax.set_title("precision")
+    ax.set_ylim(0, 1.02)
+    ax.legend()
+    ax.grid(alpha=0.3)
+
+    ax = axes[1]
+    for src, key, label in [(train, "loss", "train loss"),
+                            (evals, "eval_loss", "eval loss")]:
+        xs, ys = _column(src, key)
+        if xs:
+            ax.plot(xs, ys, label=label)
+    ax.set_xlabel("step")
+    ax.set_title("loss")
+    ax.legend()
+    ax.grid(alpha=0.3)
+
+    ax = axes[2]
+    for key in ("steps_per_sec", "images_per_sec_per_chip"):
+        xs, ys = _column(train, key)
+        if xs:
+            ax.plot(xs, ys, label=key)
+    ax.set_xlabel("step")
+    ax.set_title("throughput")
+    ax.legend()
+    ax.grid(alpha=0.3)
+
+    fig.tight_layout()
+    fig.savefig(out, dpi=110)
+    plt.close(fig)
+    return out
