@@ -179,10 +179,12 @@ class TrainConfig:
     # Continuous-eval sidecar (resnet_cifar_eval.py:140-143)
     eval_interval_secs: int = 60
     eval_once: bool = False
-    # Steps fused into one dispatch via lax.scan on the device-resident
-    # path (amortizes host→device command latency). 1 = one dispatch per
-    # step; chunks are clipped to log/checkpoint/epoch boundaries so all
-    # intervals are honored exactly.
+    # Steps fused into one dispatch via lax.scan (amortizes host→device
+    # command latency) — governs BOTH fused paths: device-resident chunks
+    # and staged streaming superbatches (there additionally capped by
+    # data.transfer_stage). 1 = one dispatch per step; chunks are clipped
+    # to log/checkpoint/epoch boundaries so all intervals are honored
+    # exactly.
     steps_per_call: int = 10
     # Profiling (tools/profiling.py): port for the live jax.profiler
     # service (0 = off) and an optional "start:stop" step window traced

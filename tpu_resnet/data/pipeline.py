@@ -154,22 +154,14 @@ def device_prefetch(host_iter: Iterator[Batch], sharding,
         yield nxt
 
 
-def staged_device_prefetch(host_iter: Iterator[Batch], stage_sharding,
-                           stage: int = 4, depth: int = 2
-                           ) -> Iterator[Tuple[jax.Array, jax.Array]]:
-    """Like ``device_prefetch`` but transfers ``stage`` batches per
-    host→device copy and cuts per-step batches on-device.
-
-    Each transfer pays a fixed command/latency cost on top of bandwidth;
-    when the interconnect to the device is latency-bound (remote-attached
-    TPU, small batches) per-batch transfers serialize against compute.
-    Staging k batches into one ``(k, B, ...)`` array amortizes that cost
-    k-fold; the per-step slice is one cheap on-device ``dynamic_slice``.
-    ``stage_sharding`` must shard the *batch* axis, i.e. ``P(None,
-    'data')`` over axis 1. A final partial stage (end of a finite stream)
-    is transferred with its true length."""
-    take = jax.jit(
-        lambda a, i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False))
+def staged_superbatch_prefetch(host_iter: Iterator[Batch], stage_sharding,
+                               stage: int = 4, depth: int = 2
+                               ) -> Iterator[Tuple[jax.Array, jax.Array, int]]:
+    """Transfer ``stage`` batches per host→device copy and yield the whole
+    ``(k, B, ...)`` superbatch plus its true length ``k`` — the consumer
+    (train/loop.py) fuses the k steps into one dispatch
+    (device_data.compile_staged_stream_steps). A final partial stage of a
+    finite stream is yielded with its true k."""
 
     def superbatches():
         it = iter(host_iter)
@@ -201,10 +193,35 @@ def staged_device_prefetch(host_iter: Iterator[Batch], stage_sharding,
     except StopIteration:
         pass
     while buf:
-        gi, gl, k = buf.popleft()
+        nxt = buf.popleft()
         try:
-            buf.append(load())  # refill before draining the current stage
+            buf.append(load())  # refill before yielding the current stage
         except StopIteration:
             pass
+        yield nxt
+
+
+def staged_device_prefetch(host_iter: Iterator[Batch], stage_sharding,
+                           stage: int = 4, depth: int = 2
+                           ) -> Iterator[Tuple[jax.Array, jax.Array]]:
+    """Like ``device_prefetch`` but transfers ``stage`` batches per
+    host→device copy and cuts per-step batches on-device.
+
+    Each transfer pays a fixed command/latency cost on top of bandwidth;
+    when the interconnect to the device is latency-bound (remote-attached
+    TPU, small batches) per-batch transfers serialize against compute.
+    Staging k batches into one ``(k, B, ...)`` array amortizes that cost
+    k-fold; the per-step slice is one cheap on-device ``dynamic_slice``.
+    ``stage_sharding`` must shard the *batch* axis, i.e. ``P(None,
+    'data')`` over axis 1. A final partial stage (end of a finite stream)
+    is transferred with its true length.
+
+    Thin per-step view over ``staged_superbatch_prefetch`` — the training
+    loop consumes the superbatches directly (fused multi-step dispatch);
+    this form serves consumers that want a per-batch iterator."""
+    take = jax.jit(
+        lambda a, i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False))
+    for gi, gl, k in staged_superbatch_prefetch(host_iter, stage_sharding,
+                                                stage=stage, depth=depth):
         for i in range(k):
             yield take(gi, i), take(gl, i)

@@ -42,7 +42,9 @@ log = logging.getLogger("tpu_resnet")
 
 def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
     """Host pipeline: per-process shard → background batcher → device
-    prefetch queue (staged: ``transfer_stage`` batches per transfer)."""
+    prefetch queue. With ``transfer_stage`` > 1 the iterator yields whole
+    ``(stage, B, ...)`` superbatches (one transfer each) plus their length;
+    the loop fuses those steps into single dispatches."""
     import tpu_resnet.data as data_lib
 
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
@@ -52,11 +54,11 @@ def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
                                start_step=start_step),
         capacity=stage * cfg.data.prefetch + 2)
     if stage > 1:
-        return pipeline.staged_device_prefetch(
+        return pipeline.staged_superbatch_prefetch(
             host_iter, parallel.staged_batch_sharding(mesh),
-            stage=stage, depth=cfg.data.prefetch)
+            stage=stage, depth=cfg.data.prefetch), stage
     return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
-                                    depth=cfg.data.prefetch)
+                                    depth=cfg.data.prefetch), 1
 
 
 def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int,
@@ -138,9 +140,13 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             per_replica_bn=per_replica_bn)
         data_iter = None
     else:
-        train_step = shard_step(base_step, mesh,
-                                per_replica_bn=per_replica_bn)
-        data_iter = build_train_iterator(cfg, mesh, start_step=step)
+        data_iter, stage = build_train_iterator(cfg, mesh, start_step=step)
+        if stage > 1:
+            run_staged = device_data.compile_staged_stream_steps(
+                base_step, mesh, per_replica_bn=per_replica_bn)
+        else:
+            train_step = shard_step(base_step, mesh,
+                                    per_replica_bn=per_replica_bn)
 
     meter = ThroughputMeter(cfg.train.global_batch_size,
                             num_chips=mesh.size)
@@ -157,6 +163,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     meter.rate(step)
     last_summary = step
     m = None  # metrics of the newest dispatched chunk
+    stage_buf = None  # current streaming superbatch: (gi, gl, k, offset)
     while step < total:
         tracer.before(step)
         if resident:
@@ -164,6 +171,21 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                            tracer.boundaries())
             state, m = run_chunk(state, step, k)
             step += k
+        elif stage > 1:
+            if stage_buf is None:
+                gi, gl, k = next(data_iter)
+                stage_buf = (gi, gl, k, 0)
+            gi, gl, k, off = stage_buf
+            # Fuse up to the stage end, clipped to the next log/summary/
+            # checkpoint/trace boundary so every hook fires at the exact
+            # steps a one-dispatch-per-step loop would fire it.
+            c = min(k - off,
+                    _chunk_len(step, total, cfg.train, 0,
+                               tracer.boundaries()))
+            state, m = run_staged(state, gi, gl, off, c)
+            step += c
+            off += c
+            stage_buf = None if off >= k else (gi, gl, k, off)
         else:
             images, labels = next(data_iter)
             state, m = train_step(state, images, labels)
