@@ -10,18 +10,35 @@ import sys
 import pytest
 
 
-@pytest.mark.slow
-def test_cifar_workflow_example(tmp_path):
+def _run_example(name, tmp_path, timeout):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = os.path.join(repo, "examples", "cifar_workflow.py")
+    script = os.path.join(repo, "examples", name)
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU: the walkthrough's default
     proc = subprocess.run(
         [sys.executable, script, str(tmp_path / "work")],
         env=env, cwd=repo, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True, timeout=540)
+        stderr=subprocess.STDOUT, text=True, timeout=timeout)
     assert proc.returncode == 0, proc.stdout[-3000:]
+    return proc
+
+
+@pytest.mark.slow
+def test_cifar_workflow_example(tmp_path):
+    proc = _run_example("cifar_workflow.py", tmp_path, timeout=540)
     # Every advertised artifact exists.
     for sub in ("train", "frozen", "predictions"):
         assert (tmp_path / "work" / sub).is_dir(), sub
     assert "eval @ step" in proc.stdout or "precision" in proc.stdout
+
+
+@pytest.mark.slow
+def test_imagenet_workflow_example(tmp_path):
+    """The ImageNet notebook-parity walkthrough: synthetic TFRecord shards
+    → streaming-path training → export → label-mapped prediction."""
+    proc = _run_example("imagenet_workflow.py", tmp_path, timeout=540)
+    for sub in ("data", "train", "frozen", "predictions"):
+        assert (tmp_path / "work" / sub).is_dir(), sub
+    assert "precision over" in proc.stdout
+    assert (tmp_path / "work" / "predictions"
+            / "predictions.json").exists()
