@@ -38,11 +38,18 @@ _LANE = 128
 _NEG = -1e30
 
 
-def _is_tpu() -> bool:
+def is_tpu_backend() -> bool:
+    """True when the default backend drives TPU chips — including PJRT
+    plugins that register under a non-'tpu' platform name (e.g. tunneled
+    plugins) but expose a 'TPU vX' device_kind."""
     try:
-        return jax.devices()[0].platform == "tpu"
+        d = jax.devices()[0]
+        return d.platform == "tpu" or "tpu" in d.device_kind.lower()
     except Exception:
         return False
+
+
+_is_tpu = is_tpu_backend
 
 
 def _block_spec(shape):

@@ -91,9 +91,10 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
     # auto-sharded jit the per-example kernel is itself shard_mapped over
     # the batch ('data') axis — embarrassingly parallel, no collectives —
     # and the mean is taken outside.
+    from tpu_resnet.ops import is_tpu_backend
     use_pallas = (getattr(optim_cfg, "use_pallas_xent", False)
                   and optim_cfg.label_smoothing == 0.0
-                  and jax.default_backend() == "tpu")
+                  and is_tpu_backend())
     if use_pallas:
         from tpu_resnet.ops import softmax_xent_mean as _pallas_xent
         from tpu_resnet.ops import softmax_xent_per_example
