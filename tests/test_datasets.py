@@ -1,0 +1,82 @@
+"""Dataset fetch tool (tools/datasets.py): extraction, layout validation,
+MD5 gating — tested offline against a locally built archive with real
+CIFAR-format records."""
+
+import hashlib
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from tpu_resnet.data.cifar import load_cifar
+from tpu_resnet.tools import datasets
+
+
+def _cifar10_archive(tmp_path, n_per_file=4):
+    """A structurally valid cifar-10-binary.tar.gz: 5 train files + test,
+    records = 1 label byte + 3072 depth-major image bytes."""
+    rng = np.random.default_rng(0)
+
+    def records():
+        recs = []
+        for _ in range(n_per_file):
+            label = bytes([int(rng.integers(0, 10))])
+            img = rng.integers(0, 256, 3072, dtype=np.uint8).tobytes()
+            recs.append(label + img)
+        return b"".join(recs)
+
+    archive = tmp_path / "cifar-10-binary.tar.gz"
+    with tarfile.open(archive, "w:gz") as tar:
+        names = [f"data_batch_{i}.bin" for i in range(1, 6)] + [
+            "test_batch.bin"]
+        for name in names:
+            data = records()
+            info = tarfile.TarInfo(f"cifar-10-batches-bin/{name}")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        # stray top-level member that must NOT be extracted
+        info = tarfile.TarInfo("unrelated.txt")
+        info.size = 2
+        tar.addfile(info, io.BytesIO(b"hi"))
+    return archive
+
+
+def test_extract_validate_and_load(tmp_path):
+    archive = _cifar10_archive(tmp_path)
+    out = tmp_path / "data"
+    datasets.extract_archive(str(archive), str(out), "cifar-10-batches-bin")
+    datasets.validate_layout("cifar10", str(out))
+    images, labels = load_cifar("cifar10", str(out), train=True,
+                                use_native=False)
+    assert images.shape == (20, 32, 32, 3)
+    assert labels.min() >= 0 and labels.max() < 10
+    assert not (out / "unrelated.txt").exists()  # filtered member
+
+
+def test_fetch_uses_existing_archive_and_checks_md5(tmp_path, monkeypatch):
+    """With the archive already present, fetch() never touches the
+    network: MD5-verify → extract → validate → delete archive."""
+    archive = _cifar10_archive(tmp_path)
+    md5 = hashlib.md5(archive.read_bytes()).hexdigest()
+    monkeypatch.setitem(datasets._ARCHIVES["cifar10"], "md5", md5)
+
+    def no_network(*a, **k):
+        raise AssertionError("network touched despite existing archive")
+
+    monkeypatch.setattr(datasets.urllib.request, "urlretrieve", no_network)
+    out = datasets.fetch("cifar10", str(tmp_path))
+    datasets.validate_layout("cifar10", out)
+    assert not archive.exists()  # consumed by default
+
+    # corrupt archive → loud MD5 failure
+    bad = _cifar10_archive(tmp_path)
+    bad.write_bytes(bad.read_bytes() + b"x")
+    with pytest.raises(ValueError, match="MD5"):
+        datasets.fetch("cifar10", str(tmp_path))
+
+
+def test_imagenet_prints_help(tmp_path, capsys):
+    datasets.fetch("imagenet", str(tmp_path))
+    assert "TFRecord" in capsys.readouterr().out

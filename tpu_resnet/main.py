@@ -37,11 +37,13 @@ def main(argv=None):
         ("predict", "run a frozen artifact over the eval split"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
+        ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
     ]:
         p = sub.add_parser(name, help=help_text)
-        p.add_argument("--preset", default="")
-        p.add_argument("--config", default="")
-        p.add_argument("overrides", nargs="*")
+        if name != "fetch":  # fetch takes a dataset name, not a run config
+            p.add_argument("--preset", default="")
+            p.add_argument("--config", default="")
+            p.add_argument("overrides", nargs="*")
         if name == "eval":
             p.add_argument("--once", action="store_true",
                            help="evaluate latest checkpoint once and exit")
@@ -67,7 +69,17 @@ def main(argv=None):
             p.add_argument("--out", default=None, help="output PNG path")
             p.add_argument("--csv", default=None,
                            help="also export merged series as CSV")
+        if name == "fetch":
+            p.add_argument("dataset",
+                           choices=["cifar10", "cifar100", "imagenet"])
+            p.add_argument("--out", required=True, help="dataset directory")
+            p.add_argument("--keep-archive", action="store_true")
     args = parser.parse_args(argv)
+
+    if args.command == "fetch":
+        from tpu_resnet.tools.datasets import fetch
+        fetch(args.dataset, args.out, keep_archive=args.keep_archive)
+        return 0
 
     from tpu_resnet.config import load_config
     cfg = load_config(args.preset, args.config, args.overrides)

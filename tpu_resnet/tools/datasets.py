@@ -1,0 +1,116 @@
+"""Dataset acquisition — the reference leaves users to fetch CIFAR
+binaries and ImageNet TFRecords by hand (its input code just expects
+``<data_dir>/cifar-10-batches-bin/...``, reference
+resnet_cifar_train.py:141-155, and Inception-style shards,
+resnet_imagenet_train.py:105-114). Here:
+
+    python -m tpu_resnet fetch cifar10  --out /data/cifar
+    python -m tpu_resnet fetch cifar100 --out /data/cifar
+
+downloads the canonical binary archive, verifies its MD5, extracts it,
+and validates the on-disk layout against the loader. ImageNet has no
+canonical public URL (license-gated); ``fetch imagenet`` prints the
+expected shard layout instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tarfile
+import urllib.request
+
+_ARCHIVES = {
+    "cifar10": {
+        "url": "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz",
+        "md5": "c32a1d4ab5d03f1284b67883e8d87530",
+        "member_prefix": "cifar-10-batches-bin",
+    },
+    "cifar100": {
+        "url": "https://www.cs.toronto.edu/~kriz/cifar-100-binary.tar.gz",
+        "md5": "03b5dce01913d631647c71ecec9e9cb8",
+        "member_prefix": "cifar-100-binary",
+    },
+}
+
+_IMAGENET_HELP = """\
+ImageNet is license-gated; no canonical public URL exists. Provide
+Inception-style TFRecord shards under data.data_dir:
+
+    train-00000-of-01024 ... train-01023-of-01024
+    validation-00000-of-00128 ... validation-00127-of-00128
+
+Each record is a tf.train.Example with keys image/encoded (JPEG bytes)
+and image/class/label (int64, 1-based). The label map file format
+consumed by `predict --label-file` is the reference's
+data/imagenet1000_clsidx_to_labels.txt ("{0: 'tench, Tinca tinca', ...").
+"""
+
+
+def _md5(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def extract_archive(archive: str, out_dir: str, member_prefix: str) -> str:
+    """Extract only the expected dataset members (defends against
+    path-traversal names in a tampered archive) and return the dataset
+    directory."""
+    os.makedirs(out_dir, exist_ok=True)
+    with tarfile.open(archive, "r:gz") as tar:
+        members = [m for m in tar.getmembers()
+                   if m.name == member_prefix
+                   or m.name.startswith(member_prefix + "/")]
+        if not members:
+            raise ValueError(
+                f"{archive}: no members under {member_prefix!r}")
+        for m in members:
+            if not m.isdir() and not m.isfile():
+                raise ValueError(f"{archive}: refusing non-file member "
+                                 f"{m.name!r}")
+            # 'data' filter: strips setuid/devices/abs-paths (PEP 706)
+            tar.extract(m, out_dir, filter="data")
+    return os.path.join(out_dir, member_prefix)
+
+
+def validate_layout(dataset: str, data_dir: str) -> None:
+    """The loader's own file resolution is the layout check."""
+    from tpu_resnet.data.cifar import cifar_files
+
+    for train in (True, False):
+        cifar_files(dataset, data_dir, train)
+
+
+def fetch(dataset: str, out_dir: str, keep_archive: bool = False) -> str:
+    """Download + verify + extract; returns the data_dir to configure."""
+    if dataset == "imagenet":
+        print(_IMAGENET_HELP)
+        return out_dir
+    if dataset not in _ARCHIVES:
+        raise ValueError(f"unknown dataset {dataset!r}; "
+                         f"have {sorted(_ARCHIVES)} + imagenet")
+    spec = _ARCHIVES[dataset]
+    os.makedirs(out_dir, exist_ok=True)
+    archive = os.path.join(out_dir, os.path.basename(spec["url"]))
+    if not os.path.exists(archive):
+        print(f"downloading {spec['url']} -> {archive}")
+        tmp = archive + ".part"
+        urllib.request.urlretrieve(spec["url"], tmp)
+        os.replace(tmp, archive)
+    got = _md5(archive)
+    if got != spec["md5"]:
+        raise ValueError(f"{archive}: MD5 {got} != expected {spec['md5']} "
+                         "(corrupt/partial download — delete and retry)")
+    extract_archive(archive, out_dir, spec["member_prefix"])
+    validate_layout(dataset, out_dir)
+    if not keep_archive:
+        os.remove(archive)
+    print(f"{dataset} ready under {out_dir} "
+          f"(use data.data_dir={out_dir})")
+    return out_dir
