@@ -1,0 +1,51 @@
+"""Smoke the bench measurement functions at tiny config on the CPU mesh —
+so the driver's unattended TPU bench can't be the first-ever execution of
+any measurement path (round-1 failure mode)."""
+
+import jax
+import pytest
+
+import bench
+from tpu_resnet.parallel import create_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return create_mesh(None, devices=jax.devices()[:8])
+
+
+def test_measure_cifar_streaming_smoke(mesh):
+    sps = bench._measure_cifar_streaming(
+        mesh, warmup_super=1, measure_super=1, stage=2, resnet_size=8,
+        batch=16, dtype="float32", split=256)
+    assert sps > 0
+
+
+@pytest.mark.slow
+def test_measure_imagenet_smoke(mesh):
+    sps, flops = bench._measure_imagenet(
+        mesh, warmup_steps=1, measure_steps=2, resnet_size=18, batch=16,
+        image=64, dtype="float32")
+    assert sps > 0
+    assert flops is None or flops > 0
+
+
+def test_peak_flops_table():
+    assert bench._peak_flops("TPU v5 lite") == 197e12
+    assert bench._peak_flops("TPU v4") == 275e12
+    assert bench._peak_flops("TPU v5p") == 459e12
+    assert bench._peak_flops("mystery chip") is None
+
+
+def test_parse_result_and_emit(capsys):
+    out = "noise\nRESULT_JSON: {\"backend\": \"tpu\", \"cifar\": " \
+          "{\"steps_per_sec\": 100.0}}\n"
+    result = bench._parse_result(out)
+    cifar = result.pop("cifar")
+    bench._emit(result, cifar["steps_per_sec"])
+    import json
+    line = json.loads(capsys.readouterr().out)
+    assert line["metric"] == bench.HEADLINE_METRIC
+    assert line["value"] == 100.0
+    assert line["vs_baseline"] == round(100.0 / 13.94, 2)
+    assert line["backend"] == "tpu"

@@ -1,0 +1,51 @@
+"""Metrics writer: jsonl + TensorBoard event channel round-trip (the
+reference's SummarySaverHook channel, resnet_cifar_train.py:275-280) and
+the throughput meter."""
+
+import glob
+import json
+import time
+
+from tpu_resnet.train.metrics_io import MetricsWriter, ThroughputMeter
+
+
+def test_jsonl_and_tensorboard_roundtrip(tmp_path):
+    w = MetricsWriter(str(tmp_path))
+    w.write(20, {"loss": 1.5, "precision": 0.25})
+    w.write(40, {"loss": 1.0, "precision": 0.5})
+    w.close()
+
+    recs = [json.loads(line)
+            for line in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert [r["step"] for r in recs] == [20, 40]
+    assert recs[1]["precision"] == 0.5
+
+    events = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert events, "TensorBoard event file not written (TF is available)"
+    from tensorflow.compat.v1.train import summary_iterator
+    seen = {}
+    for ev in summary_iterator(events[0]):
+        for v in ev.summary.value:
+            if v.HasField("tensor"):
+                import tensorflow as tf
+                seen[(v.tag, ev.step)] = float(
+                    tf.make_ndarray(v.tensor))
+    assert seen[("loss", 40)] == 1.0
+    assert seen[("precision", 20)] == 0.25
+
+
+def test_disabled_writer_writes_nothing(tmp_path):
+    w = MetricsWriter(str(tmp_path / "x"), enabled=False)
+    w.write(1, {"loss": 1.0})
+    w.close()
+    assert not (tmp_path / "x").exists()
+
+
+def test_throughput_meter_rates():
+    m = ThroughputMeter(global_batch=128, num_chips=4)
+    assert m.rate(0) is None  # first call only arms the meter
+    time.sleep(0.05)
+    out = m.rate(10)
+    assert out and out["steps_per_sec"] > 0
+    assert out["images_per_sec"] == out["steps_per_sec"] * 128
+    assert out["images_per_sec_per_chip"] == out["images_per_sec"] / 4

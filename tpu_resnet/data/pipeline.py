@@ -101,16 +101,35 @@ class BackgroundIterator:
     def __init__(self, it: Iterator, capacity: int = 4):
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
         self._it = it
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
     def _fill(self):
         try:
             for item in self._it:
-                self._q.put(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
         except Exception as e:  # surface loader errors to the consumer
             self._q.put(e)
         self._q.put(StopIteration)
+
+    def close(self):
+        """Release the producer thread and its buffered items (for
+        consumers that stop early, e.g. benchmark warm-ups)."""
+        self._stop.set()
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5)
 
     def __iter__(self):
         return self

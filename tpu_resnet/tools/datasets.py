@@ -74,8 +74,12 @@ def extract_archive(archive: str, out_dir: str, member_prefix: str) -> str:
             if not m.isdir() and not m.isfile():
                 raise ValueError(f"{archive}: refusing non-file member "
                                  f"{m.name!r}")
-            # 'data' filter: strips setuid/devices/abs-paths (PEP 706)
-            tar.extract(m, out_dir, filter="data")
+            try:
+                # 'data' filter: strips setuid/devices/abs-paths (PEP 706)
+                tar.extract(m, out_dir, filter="data")
+            except TypeError:  # pre-3.10.12 tarfile: no filter kwarg —
+                # the member whitelist above already blocks traversal names
+                tar.extract(m, out_dir)
     return os.path.join(out_dir, member_prefix)
 
 
@@ -105,8 +109,9 @@ def fetch(dataset: str, out_dir: str, keep_archive: bool = False) -> str:
         os.replace(tmp, archive)
     got = _md5(archive)
     if got != spec["md5"]:
+        os.remove(archive)  # so a plain retry re-downloads
         raise ValueError(f"{archive}: MD5 {got} != expected {spec['md5']} "
-                         "(corrupt/partial download — delete and retry)")
+                         "(corrupt/partial download removed — rerun fetch)")
     extract_archive(archive, out_dir, spec["member_prefix"])
     validate_layout(dataset, out_dir)
     if not keep_archive:
