@@ -1,0 +1,30 @@
+"""Driver-contract tests: the two entry points the round harness invokes
+must keep working exactly as invoked — round 1 was lost to this file's
+dryrun hanging under the driver's ambient environment."""
+
+import pytest
+
+import __graft_entry__ as graft
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8_from_ambient_env():
+    """The driver's exact call: dryrun_multichip(8) from a process with
+    no environment preparation. The subprocess re-exec must force the
+    CPU platform itself."""
+    graft.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16_devices():
+    """The sharding layout must scale beyond the default 8-device mesh
+    (pod-shaped data axis)."""
+    graft.dryrun_multichip(16)
+
+
+def test_entry_returns_jittable_forward():
+    import jax
+
+    fn, (variables, images) = graft.entry()
+    out = jax.eval_shape(fn, variables, images)  # traces without running
+    assert out.shape == (images.shape[0], 1000)
