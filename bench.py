@@ -69,32 +69,48 @@ def _peak_flops(device_kind: str):
 # measurement children (import jax; run under the parent's timeouts)
 # --------------------------------------------------------------------------
 
-def _measure_cifar(mesh, warmup_chunks, measure_chunks, steps_per_call):
+def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
+                       synthetic=False):
+    """Shared measurement scaffolding: resolved config + model + schedule
+    + replicated initial state (one copy of what every measurement
+    needs)."""
     import jax
     import jax.numpy as jnp
 
     from tpu_resnet.config import load_config
     from tpu_resnet import parallel
-    from tpu_resnet.data import cifar as cifar_data
-    from tpu_resnet.data import device_data
-    from tpu_resnet.data.augment import get_augment_fns
     from tpu_resnet.models import build_model
     from tpu_resnet.train import build_schedule, init_state
-    from tpu_resnet.train.step import make_train_step
 
-    cfg = load_config("cifar10")
-    cfg.data.dataset = "synthetic"
-    cfg.train.global_batch_size = 128
-    cfg.model.resnet_size = 50
-    cfg.model.compute_dtype = "bfloat16"
-    k = steps_per_call
+    cfg = load_config(preset)
+    if synthetic:
+        cfg.data.dataset = "synthetic"
+    cfg.data.image_size = image
+    cfg.train.global_batch_size = batch
+    cfg.model.resnet_size = resnet_size
+    cfg.model.compute_dtype = dtype
 
     model = build_model(cfg)
     sched = build_schedule(cfg.optim, cfg.train)
     rng = jax.random.PRNGKey(0)
     state = init_state(model, cfg.optim, sched, rng,
-                       jnp.zeros((1, 32, 32, 3)))
+                       jnp.zeros((1, image, image, 3)))
     state = jax.device_put(state, parallel.replicated(mesh))
+    return cfg, model, sched, state, rng
+
+
+def _measure_cifar(mesh, warmup_chunks, measure_chunks, steps_per_call):
+    import jax
+
+    from tpu_resnet.data import cifar as cifar_data
+    from tpu_resnet.data import device_data
+    from tpu_resnet.data.augment import get_augment_fns
+    from tpu_resnet.train.step import make_train_step
+
+    cfg, model, sched, state, rng = _build_train_setup(
+        mesh, "cifar10", resnet_size=50, batch=128, dtype="bfloat16",
+        image=32, synthetic=True)
+    k = steps_per_call
 
     # CIFAR-10-sized synthetic split, resident in HBM like a real run.
     images, labels = cifar_data.synthetic_data(50_000, 32, 10)
@@ -128,30 +144,17 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
     ImageNet runs use. Comparable to the same 13.94 baseline: the
     reference's step also included its host input pipeline."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from tpu_resnet.config import load_config
     from tpu_resnet import parallel
     from tpu_resnet.data import device_data, pipeline
     from tpu_resnet.data import cifar as cifar_data
     from tpu_resnet.data.augment import get_augment_fns
-    from tpu_resnet.models import build_model
-    from tpu_resnet.train import build_schedule, init_state
     from tpu_resnet.train.step import make_train_step
 
-    cfg = load_config("cifar10")
-    cfg.data.dataset = "synthetic"
-    cfg.train.global_batch_size = batch
-    cfg.model.resnet_size = resnet_size
-    cfg.model.compute_dtype = dtype
-
-    model = build_model(cfg)
-    sched = build_schedule(cfg.optim, cfg.train)
-    rng = jax.random.PRNGKey(0)
-    state = init_state(model, cfg.optim, sched, rng,
-                       jnp.zeros((1, 32, 32, 3)))
-    state = jax.device_put(state, parallel.replicated(mesh))
+    cfg, model, sched, state, rng = _build_train_setup(
+        mesh, "cifar10", resnet_size=resnet_size, batch=batch, dtype=dtype,
+        image=32, synthetic=True)
 
     images, labels = cifar_data.synthetic_data(split, 32, 10)
     batcher = pipeline.ShardedBatcher(images, labels.astype(np.int32),
@@ -207,27 +210,14 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
     synthetic pre-processed input resident on device. Returns
     (steps/s, flops_per_step or None)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from tpu_resnet.config import load_config
     from tpu_resnet import parallel
-    from tpu_resnet.models import build_model
-    from tpu_resnet.train import build_schedule, init_state
     from tpu_resnet.train.step import make_train_step, shard_step
 
-    cfg = load_config("imagenet")
-    cfg.train.global_batch_size = batch
-    cfg.data.image_size = image
-    cfg.model.resnet_size = resnet_size
-    cfg.model.compute_dtype = dtype
-
-    model = build_model(cfg)
-    sched = build_schedule(cfg.optim, cfg.train)
-    rng = jax.random.PRNGKey(0)
-    state = init_state(model, cfg.optim, sched, rng,
-                       jnp.zeros((1, image, image, 3)))
-    state = jax.device_put(state, parallel.replicated(mesh))
+    cfg, model, sched, state, rng = _build_train_setup(
+        mesh, "imagenet", resnet_size=resnet_size, batch=batch,
+        dtype=dtype, image=image)
 
     # Pre-processed (VGG mean-subtracted) float input, as the host pipeline
     # would deliver it; one resident batch re-fed each step so the

@@ -19,13 +19,15 @@ import sys
 
 import pytest
 
-WORKER = r"""
+PREAMBLE = r"""
 import os, sys, json
 import jax
 jax.config.update("jax_platforms", "cpu")
 
 from tpu_resnet import parallel
+"""
 
+WORKER = PREAMBLE + r"""
 parallel.initialize()  # from TPU_* env vars (launcher protocol)
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()
@@ -68,13 +70,7 @@ print(json.dumps({"process": jax.process_index(), "loss": loss,
 """
 
 
-EVAL_WORKER = r"""
-import os, sys, json
-import jax
-jax.config.update("jax_platforms", "cpu")
-
-from tpu_resnet import parallel
-
+EVAL_WORKER = PREAMBLE + r"""
 parallel.initialize()  # from TPU_* env vars (launcher protocol)
 assert jax.process_count() == 2
 
@@ -98,15 +94,11 @@ print(json.dumps({"process": jax.process_index(),
 """
 
 
-IMAGENET_WORKER = r"""
-import io, json, os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-
+IMAGENET_WORKER = PREAMBLE + r"""
+import io
 import numpy as np
 from PIL import Image
 
-from tpu_resnet import parallel
 from tpu_resnet.config import load_config
 from tpu_resnet.data import tfrecord
 from tpu_resnet.train.loop import train
