@@ -249,6 +249,89 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
     return measure_steps / dt, flops
 
 
+def _measure_host_decode(n_images=200, size=(640, 480)):
+    """Host-side JPEG decode + VGG preprocess throughput (images/s),
+    native C++ (libjpeg) vs PIL — the ImageNet input edge the reference
+    bounded with 16 queue threads + num_parallel_calls=4
+    (cifar_input.py:99-100, resnet_imagenet_train.py:170-171). Backend-
+    independent; run per host."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from tpu_resnet.data.imagenet import decode_and_crop
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 256, (size[1], size[0], 3), np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "JPEG", quality=90)
+    jpeg = buf.getvalue()
+
+    from tpu_resnet.native import jpeg_available
+
+    out = {"native_jpeg_built": bool(jpeg_available())}
+    for label, use_native in (("native", True), ("pil", False)):
+        d_rng = np.random.default_rng(1)
+        decode_and_crop(jpeg, True, d_rng, use_native=use_native)  # warm
+        t0 = time.perf_counter()
+        for _ in range(n_images):
+            decode_and_crop(jpeg, True, d_rng, use_native=use_native)
+        rate = n_images / (time.perf_counter() - t0)
+        out[f"{label}_images_per_sec"] = round(rate, 1)
+    if out.get("pil_images_per_sec"):
+        out["native_speedup"] = round(
+            out["native_images_per_sec"] / out["pil_images_per_sec"], 2)
+    return out
+
+
+def _measure_record_split(n_records=400, record_bytes=60_000):
+    """CRC32C-verified TFRecord shard read throughput (MB/s), native C++
+    plane vs pure-python — the tf.data C++ reader role (SURVEY.md §2.4).
+    Verified reads are the native plane's headline win (~200x measured);
+    plain framing reads are memcpy-bound either way and reported too."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from tpu_resnet.data import tfrecord
+    from tpu_resnet.data.imagenet import read_shard_records
+
+    rng = np.random.default_rng(0)
+    payload = [rng.integers(0, 256, record_bytes, dtype=np.uint8).tobytes()
+               for _ in range(8)]
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "shard")
+        tfrecord.write_records(
+            path, [payload[i % 8] for i in range(n_records)])
+        from tpu_resnet.native import available
+
+        mb = os.path.getsize(path) / 1e6
+        # Label honesty: without the built library the "native" cases
+        # silently measure the python fallback.
+        out = {"native_built": bool(available())}
+        cases = (
+            ("native_crc", lambda: read_shard_records(path, use_native=True,
+                                                      verify_crc=True)),
+            ("python_crc", lambda: tfrecord.read_records(path,
+                                                         verify_crc=True)),
+            ("native_plain", lambda: read_shard_records(path,
+                                                        use_native=True)),
+            ("python_plain", lambda: tfrecord.read_records(path)),
+        )
+        for label, fn in cases:
+            sum(len(r) for r in fn())  # warm page cache
+            t0 = time.perf_counter()
+            n = sum(1 for _ in fn())
+            dt = time.perf_counter() - t0
+            assert n == n_records
+            out[f"{label}_mb_per_sec"] = round(mb / dt, 1)
+        out["native_crc_speedup"] = round(
+            out["native_crc_mb_per_sec"] / out["python_crc_mb_per_sec"], 1)
+        return out
+
+
 def _measure_pallas_ab(iters=100):
     """A/B the Pallas fused softmax-xent (fwd+bwd) against the XLA/optax
     chain at b128x10 and b128x1000 (VERDICT round 1 item 6)."""
@@ -358,6 +441,18 @@ def run_child(kind: str) -> None:
                   file=sys.stderr)
         except Exception as e:
             errors["pallas_xent_ab"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            result["host_decode"] = _measure_host_decode()
+            print(f"[bench child] host decode: {result['host_decode']}",
+                  file=sys.stderr)
+        except Exception as e:
+            errors["host_decode"] = f"{type(e).__name__}: {e}"[:500]
+        try:
+            result["record_split"] = _measure_record_split()
+            print(f"[bench child] record split: {result['record_split']}",
+                  file=sys.stderr)
+        except Exception as e:
+            errors["record_split"] = f"{type(e).__name__}: {e}"[:500]
 
     if errors:
         result["errors"] = errors

@@ -49,3 +49,15 @@ def test_parse_result_and_emit(capsys):
     assert line["value"] == 100.0
     assert line["vs_baseline"] == round(100.0 / 13.94, 2)
     assert line["backend"] == "tpu"
+
+
+def test_measure_host_decode():
+    out = bench._measure_host_decode(n_images=20, size=(320, 240))
+    assert out["native_images_per_sec"] > 0
+    assert out["pil_images_per_sec"] > 0
+
+
+def test_measure_record_split():
+    out = bench._measure_record_split(n_records=40)
+    assert out["native_crc_mb_per_sec"] > 0
+    assert out["python_crc_mb_per_sec"] > 0

@@ -49,6 +49,10 @@ class DataConfig:
     image_size: int = 0  # 0 = dataset default (32 cifar / 224 imagenet)
     # Use the native C++ loader when the shared library is built.
     use_native_loader: bool = True
+    # Verify the masked CRC32C of every TFRecord read. Near-free with the
+    # native plane (~700 MB/s measured; the pure-python CRC is ~3 MB/s),
+    # so corrupted shards fail loudly instead of feeding garbage JPEGs.
+    verify_records: bool = False
     # Device-resident dataset (data/device_data.py): upload the whole
     # training split to HBM once and cut batches on-device — removes all
     # per-step host→device traffic. "auto" enables it for single-process

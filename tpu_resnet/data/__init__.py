@@ -37,7 +37,8 @@ def train_batches(data_cfg, local_batch: int, seed: int = 0,
             start_step=start_step,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
-            image_size=data_cfg.resolved_image_size))
+            image_size=data_cfg.resolved_image_size,
+            verify_records=data_cfg.verify_records))
     images, labels = load_split(data_cfg, train=True)
     return iter(ShardedBatcher(images, labels, local_batch, seed=seed,
                                start_step=start_step))
@@ -63,6 +64,7 @@ def eval_split_batches(data_cfg, batch: int,
         return eval_examples(data_cfg.data_dir, batch,
                              num_workers=data_cfg.num_workers,
                              process_index=pi, process_count=pc,
-                             image_size=data_cfg.resolved_image_size)
+                             image_size=data_cfg.resolved_image_size,
+                             verify_records=data_cfg.verify_records)
     images, labels = load_split(data_cfg, train=False)
     return eval_batches(images[pi::pc], labels[pi::pc], batch)

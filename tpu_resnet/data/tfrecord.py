@@ -57,6 +57,19 @@ def masked_crc32c(data: bytes) -> int:
     return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
 
 
+def masked_crc32c_fast(data: bytes) -> int:
+    """masked_crc32c through the native C table when built (~200x the
+    python loop) — for verification on hot read paths."""
+    try:
+        from tpu_resnet.native import available, loader
+        if available():
+            crc = loader.crc32c(data)
+            return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+    except Exception:
+        pass
+    return masked_crc32c(data)
+
+
 # ----------------------------------------------------------- record framing
 def write_records(path: str, records: List[bytes]) -> None:
     with open(path, "wb") as f:

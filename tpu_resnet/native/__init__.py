@@ -123,8 +123,9 @@ class loader:
 
     @staticmethod
     def tfrecord_payloads(path: str, verify_crc: bool = False):
-        """All record payloads of a TFRecord file as memoryviews over one
-        buffer (TFRecordDataset role)."""
+        """All record payloads of a TFRecord file as bytes
+        (TFRecordDataset role): one bulk GIL-released file read, one C
+        framing/CRC pass, then exactly one copy per payload."""
         lib = _load()
         size = os.path.getsize(path)
         buf = np.empty(size, np.uint8)
@@ -132,7 +133,7 @@ class loader:
                                buf.ctypes.data_as(ctypes.c_void_p), size)
         if got != size:
             raise IOError(f"short read on {path}")
-        max_records = max(16, size // 24)
+        max_records = max(16, size // 16)  # min framed record = 16 bytes
         spans = np.empty(2 * max_records, np.int64)
         n = lib.tr_tfrecord_split(
             buf.ctypes.data_as(ctypes.c_void_p), size,
@@ -144,8 +145,9 @@ class loader:
             raise ValueError(f"{path}: CRC mismatch")
         if n < 0:
             raise ValueError(f"{path}: split failed ({n})")
-        data = buf.tobytes()
-        return [data[spans[2 * i]:spans[2 * i] + spans[2 * i + 1]]
+        sp = spans[:2 * int(n)].tolist()
+        mv = memoryview(buf)
+        return [bytes(mv[sp[2 * i]:sp[2 * i] + sp[2 * i + 1]])
                 for i in range(int(n))]
 
     @staticmethod
