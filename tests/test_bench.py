@@ -14,6 +14,16 @@ def mesh():
     return create_mesh(None, devices=jax.devices()[:8])
 
 
+def test_measure_cifar_multiplan_smoke(mesh):
+    """Two fusion factors share one setup; each plan aligns to an epoch
+    boundary and yields a positive rate."""
+    by_k = bench._measure_cifar(mesh, [(2, 1, 2), (4, 1, 2)],
+                                resnet_size=8, batch=16, dtype="float32",
+                                split=256)
+    assert set(by_k) == {2, 4}
+    assert all(v > 0 for v in by_k.values())
+
+
 def test_measure_cifar_streaming_smoke(mesh):
     sps = bench._measure_cifar_streaming(
         mesh, warmup_super=1, measure_super=1, stage=2, resnet_size=8,
