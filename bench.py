@@ -70,10 +70,12 @@ def _peak_flops(device_kind: str):
 # --------------------------------------------------------------------------
 
 def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
-                       synthetic=False):
+                       synthetic=False, width=None, num_classes=None):
     """Shared measurement scaffolding: resolved config + model + schedule
     + replicated initial state (one copy of what every measurement
-    needs)."""
+    needs). ``None`` overrides keep the preset's values; ``synthetic``
+    swaps the dataset for download-free data with the same class count
+    (unless ``num_classes`` overrides it)."""
     import jax
     import jax.numpy as jnp
 
@@ -84,10 +86,18 @@ def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
 
     cfg = load_config(preset)
     if synthetic:
+        classes = num_classes or cfg.data.num_classes
         cfg.data.dataset = "synthetic"
+        cfg.data.synthetic_classes = classes
+    elif num_classes is not None and num_classes != cfg.data.num_classes:
+        raise ValueError(f"num_classes={num_classes} conflicts with "
+                         f"preset {preset!r} ({cfg.data.num_classes})")
     cfg.data.image_size = image
     cfg.train.global_batch_size = batch
-    cfg.model.resnet_size = resnet_size
+    if resnet_size is not None:
+        cfg.model.resnet_size = resnet_size
+    if width is not None:
+        cfg.model.width_multiplier = width
     cfg.model.compute_dtype = dtype
 
     model = build_model(cfg)
@@ -99,9 +109,11 @@ def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
     return cfg, model, sched, state, rng
 
 
-def _measure_cifar(mesh, plans, resnet_size=50, batch=128,
-                   dtype="bfloat16", split=50_000):
-    """Resident-path CIFAR measurement over one shared setup.
+def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
+                   batch=128, dtype="bfloat16", split=50_000, width=None,
+                   num_classes=None):
+    """Resident-path CIFAR-shaped measurement over one shared setup; model
+    and optimizer come from ``preset`` (overridable for smoke tests).
 
     ``plans`` is a list of (steps_per_call, warmup_chunks, measure_chunks);
     each plan starts at an epoch boundary and must fit within one epoch
@@ -115,17 +127,18 @@ def _measure_cifar(mesh, plans, resnet_size=50, batch=128,
     from tpu_resnet.train.step import make_train_step
 
     cfg, model, sched, state, rng = _build_train_setup(
-        mesh, "cifar10", resnet_size=resnet_size, batch=batch, dtype=dtype,
-        image=32, synthetic=True)
+        mesh, preset, resnet_size=resnet_size, batch=batch, dtype=dtype,
+        image=32, synthetic=True, width=width, num_classes=num_classes)
 
-    # CIFAR-10-sized synthetic split, resident in HBM like a real run.
-    images, labels = cifar_data.synthetic_data(split, 32, 10)
+    # CIFAR-sized synthetic split, resident in HBM like a real run.
+    images, labels = cifar_data.synthetic_data(split, 32,
+                                               cfg.data.num_classes)
     ds = device_data.DeviceDataset(mesh, images, labels,
                                    cfg.train.global_batch_size, seed=0)
     augment_fn, _ = get_augment_fns("cifar10")
     run_chunk = device_data.compile_resident_steps(
-        make_train_step(model, cfg.optim, sched, 10, augment_fn,
-                        base_rng=rng, mesh=mesh), ds, mesh,
+        make_train_step(model, cfg.optim, sched, cfg.data.num_classes,
+                        augment_fn, base_rng=rng, mesh=mesh), ds, mesh,
         max(k for k, _, _ in plans))
 
     spe = ds.steps_per_epoch
@@ -492,6 +505,21 @@ def run_child(kind: str) -> None:
                       file=sys.stderr)
             except Exception as e:
                 errors[f"imagenet_b{b2}"] = f"{type(e).__name__}: {e}"[:500]
+        # BASELINE.json config 4: Wide-ResNet-28-10 CIFAR-100 b128 — the
+        # reference's wide-variant exercise, no published speed line (the
+        # entry records our absolute number for cross-round tracking).
+        try:
+            wrn_batch = 128
+            wrn = _measure_cifar(mesh, [(10, 2, 10)],
+                                 preset="wrn28_10_cifar100",
+                                 batch=wrn_batch)
+            result["wrn28_10_cifar100"] = {
+                "steps_per_sec": round(wrn[10], 2),
+                "images_per_sec": round(wrn[10] * wrn_batch, 1)}
+            print(f"[bench child] wrn28-10: {wrn[10]:.2f} steps/s",
+                  file=sys.stderr)
+        except Exception as e:
+            errors["wrn28_10_cifar100"] = f"{type(e).__name__}: {e}"[:500]
         try:
             result["pallas_xent_ab"] = _measure_pallas_ab()
             print(f"[bench child] pallas A/B: {result['pallas_xent_ab']}",

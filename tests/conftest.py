@@ -15,4 +15,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: repeat suite runs skip recompiling the
+# (identical) test programs — the dominant cost of the suite on this
+# single-core box. Keyed by backend+program, so source changes that alter a
+# program recompile as usual. Opt out with TPU_RESNET_TEST_CACHE=0.
+if os.environ.get("TPU_RESNET_TEST_CACHE", "1") != "0":
+    _cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # Subprocess-spawning tests (multihost rendezvous, launcher dryruns)
+    # pick the cache up from the environment.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache_dir)
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

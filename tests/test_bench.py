@@ -24,6 +24,23 @@ def test_measure_cifar_multiplan_smoke(mesh):
     assert all(v > 0 for v in by_k.values())
 
 
+def test_measure_cifar_wide_smoke(mesh):
+    """The WRN entry's path: width multiplier + 100 classes."""
+    by_k = bench._measure_cifar(mesh, [(2, 1, 1)], resnet_size=10,
+                                batch=16, dtype="float32", split=64,
+                                width=2, num_classes=100)
+    assert by_k[2] > 0
+
+
+def test_measure_pallas_ab_smoke(mesh):
+    """The A/B harness's scan-fused timing loop runs end-to-end (interpret
+    -mode Pallas on CPU; tiny iteration count)."""
+    out = bench._measure_pallas_ab(iters=2)
+    assert set(out) == {"b128x10", "b128x1000"}
+    assert all(v["pallas_us"] > 0 and v["xla_us"] > 0
+               for v in out.values())
+
+
 def test_measure_cifar_streaming_smoke(mesh):
     sps = bench._measure_cifar_streaming(
         mesh, warmup_super=1, measure_super=1, stage=2, resnet_size=8,

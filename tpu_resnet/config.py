@@ -34,6 +34,9 @@ class DataConfig:
     # so training must genuinely learn — the no-download stand-in for
     # real-data convergence runs (data/cifar.py::synthetic_data).
     synthetic_learnable: bool = False
+    # synthetic only: class count (smoke-test any head size, e.g. the
+    # WRN-28-10 CIFAR-100 shape, without the real dataset bytes).
+    synthetic_classes: int = 10
     # Number of worker threads in the host loader (reference uses 16 queue
     # threads, cifar_input.py:99-100; and num_parallel_calls=4 tf.data maps).
     num_workers: int = 4
@@ -67,8 +70,10 @@ class DataConfig:
 
     @property
     def num_classes(self) -> int:
-        return {"cifar10": 10, "cifar100": 100, "imagenet": 1000,
-                "synthetic": 10}[self.dataset]
+        if self.dataset == "synthetic":
+            return self.synthetic_classes
+        return {"cifar10": 10, "cifar100": 100,
+                "imagenet": 1000}[self.dataset]
 
     @property
     def default_image_size(self) -> int:
