@@ -425,6 +425,16 @@ def run_child(kind: str) -> None:
               "n_devices": len(devices)}
     errors = {}
 
+    def snapshot():
+        """Emit the current result as a RESULT_JSON line. Later lines
+        supersede earlier ones (the parent takes the last), so a child
+        killed by a timeout mid-run still leaves its completed
+        measurements on stdout for the parent to salvage."""
+        snap = dict(result)
+        if errors:
+            snap["errors"] = dict(errors)
+        print("RESULT_JSON: " + json.dumps(snap), flush=True)
+
     if kind == "cpu":
         # Reduced counts: the CPU number is a liveness fallback, not a
         # performance claim.
@@ -443,6 +453,7 @@ def run_child(kind: str) -> None:
                                   for k, v in by_k.items()},
         }
     print(f"[bench child] cifar: {result['cifar']}", file=sys.stderr)
+    snapshot()
 
     if kind == "tpu":
         try:
@@ -455,6 +466,7 @@ def run_child(kind: str) -> None:
                   file=sys.stderr)
         except Exception as e:
             errors["cifar_streaming"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         def imagenet_entry(sps, flops, batch):
             """steps/s + images/s + MFU from per-device FLOPs (XLA cost
             analysis, analytic ResNet-50 estimate as fallback)."""
@@ -488,6 +500,7 @@ def run_child(kind: str) -> None:
                   f"mfu={entry.get('mfu')}", file=sys.stderr)
         except Exception as e:
             errors["imagenet"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         # Secondary ImageNet entry at a larger batch: the b128 line stays
         # the baseline-comparable headline; this one shows how utilization
         # scales when the MXU is given bigger tiles.
@@ -505,6 +518,7 @@ def run_child(kind: str) -> None:
                       file=sys.stderr)
             except Exception as e:
                 errors[f"imagenet_b{b2}"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         # BASELINE.json config 4: Wide-ResNet-28-10 CIFAR-100 b128 — the
         # reference's wide-variant exercise, no published speed line (the
         # entry records our absolute number for cross-round tracking).
@@ -520,12 +534,14 @@ def run_child(kind: str) -> None:
                   file=sys.stderr)
         except Exception as e:
             errors["wrn28_10_cifar100"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         try:
             result["pallas_xent_ab"] = _measure_pallas_ab()
             print(f"[bench child] pallas A/B: {result['pallas_xent_ab']}",
                   file=sys.stderr)
         except Exception as e:
             errors["pallas_xent_ab"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         try:
             result["host_decode"] = _measure_host_decode()
             print(f"[bench child] host decode: {result['host_decode']}",
@@ -539,9 +555,7 @@ def run_child(kind: str) -> None:
         except Exception as e:
             errors["record_split"] = f"{type(e).__name__}: {e}"[:500]
 
-    if errors:
-        result["errors"] = errors
-    print("RESULT_JSON: " + json.dumps(result), flush=True)
+    snapshot()
 
 
 # --------------------------------------------------------------------------
@@ -579,9 +593,15 @@ def _probe_tpu(timeout):
 
 
 def _parse_result(out: str):
+    """Last *intact* RESULT_JSON snapshot — a child killed mid-print (the
+    timeout-salvage case) can truncate its final line, in which case the
+    previous snapshot wins."""
     for line in reversed(out.splitlines()):
         if line.startswith("RESULT_JSON: "):
-            return json.loads(line[len("RESULT_JSON: "):])
+            try:
+                return json.loads(line[len("RESULT_JSON: "):])
+            except ValueError:
+                continue
     return None
 
 
@@ -603,7 +623,7 @@ def _emit(result: dict, cifar_sps, extra=None):
 def main():
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "1200"))
+    child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2100"))
     backoffs = [20, 60, 120]
     diags = []
 
@@ -623,7 +643,15 @@ def main():
                        dict(os.environ), child_timeout)
         sys.stderr.write(out)
         result = _parse_result(out)
-        if rc == 0 and result:
+        # rc=124 with a RESULT_JSON snapshot: the child ran out of time
+        # mid-battery but its completed measurements are valid — salvage
+        # the last snapshot instead of discarding a real TPU headline.
+        if result and (rc == 0 or rc == 124):
+            if rc == 124:
+                result["partial"] = True
+                result.setdefault("errors", {})["timeout"] = (
+                    f"child timed out after {child_timeout}s; entries "
+                    f"after the last snapshot are missing")
             cifar = result.pop("cifar", {})
             if len(cifar) > 1:  # keep per-k detail beside the headline
                 result["cifar_detail"] = cifar
@@ -640,7 +668,9 @@ def main():
                    max(600, child_timeout // 2))
     sys.stderr.write(out)
     result = _parse_result(out)
-    if rc == 0 and result:
+    if result and (rc == 0 or rc == 124):
+        if rc == 124:
+            result["partial"] = True
         cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
         _emit(result, cifar_sps, extra={"tpu_error": "; ".join(diags)})
         return 0

@@ -78,6 +78,27 @@ def test_parse_result_and_emit(capsys):
     assert line["backend"] == "tpu"
 
 
+def test_parse_result_takes_last_snapshot():
+    """The child emits incremental RESULT_JSON snapshots; a timed-out
+    child's most complete snapshot must win."""
+    out = ("RESULT_JSON: {\"cifar\": {\"steps_per_sec\": 1.0}}\n"
+           "noise\n"
+           "RESULT_JSON: {\"cifar\": {\"steps_per_sec\": 1.0}, "
+           "\"imagenet\": {\"value\": 2.0}}\n"
+           "[parent] timeout after 2100s\n")
+    result = bench._parse_result(out)
+    assert result["imagenet"]["value"] == 2.0
+
+
+def test_parse_result_skips_truncated_final_snapshot():
+    """A child SIGKILLed mid-print leaves a cut-off last line; the previous
+    intact snapshot must be salvaged, not a JSONDecodeError raised."""
+    out = ("RESULT_JSON: {\"cifar\": {\"steps_per_sec\": 3.5}}\n"
+           "RESULT_JSON: {\"cifar\": {\"steps_per_sec\": 3.5}, \"imag")
+    result = bench._parse_result(out)
+    assert result == {"cifar": {"steps_per_sec": 3.5}}
+
+
 def test_measure_host_decode():
     out = bench._measure_host_decode(n_images=20, size=(320, 240))
     assert out["native_images_per_sec"] > 0
