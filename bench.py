@@ -548,6 +548,7 @@ def run_child(kind: str) -> None:
                   file=sys.stderr)
         except Exception as e:
             errors["host_decode"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
         try:
             result["record_split"] = _measure_record_split()
             print(f"[bench child] record split: {result['record_split']}",
@@ -620,6 +621,19 @@ def _emit(result: dict, cifar_sps, extra=None):
     print(json.dumps(line), flush=True)
 
 
+def _salvage(result, rc, how_died):
+    """Mark a snapshot from a child that didn't exit cleanly. Completed
+    sections are valid regardless of how the child later died (timeout,
+    segfault, OOM-kill) — a later failure doesn't invalidate measurements
+    that already ran; losing them is the failure mode the incremental
+    snapshots exist to prevent."""
+    if rc != 0:
+        result["partial"] = True
+        result.setdefault("errors", {})["child_exit"] = (
+            f"{how_died}; entries after the last snapshot are missing")
+    return result
+
+
 def main():
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
@@ -643,15 +657,10 @@ def main():
                        dict(os.environ), child_timeout)
         sys.stderr.write(out)
         result = _parse_result(out)
-        # rc=124 with a RESULT_JSON snapshot: the child ran out of time
-        # mid-battery but its completed measurements are valid — salvage
-        # the last snapshot instead of discarding a real TPU headline.
-        if result and (rc == 0 or rc == 124):
-            if rc == 124:
-                result["partial"] = True
-                result.setdefault("errors", {})["timeout"] = (
-                    f"child timed out after {child_timeout}s; entries "
-                    f"after the last snapshot are missing")
+        if result:
+            result = _salvage(result, rc,
+                              f"tpu child rc={rc} after {child_timeout}s "
+                              f"budget")
             cifar = result.pop("cifar", {})
             if len(cifar) > 1:  # keep per-k detail beside the headline
                 result["cifar_detail"] = cifar
@@ -664,13 +673,14 @@ def main():
     # records a live number plus the TPU diagnostics.
     print("[bench] TPU unavailable — CPU fallback", file=sys.stderr)
     from __graft_entry__ import _cpu_env
+    cpu_timeout = max(600, child_timeout // 2)
     rc, out = _run([sys.executable, me, "--child", "cpu"], _cpu_env(1),
-                   max(600, child_timeout // 2))
+                   cpu_timeout)
     sys.stderr.write(out)
     result = _parse_result(out)
-    if result and (rc == 0 or rc == 124):
-        if rc == 124:
-            result["partial"] = True
+    if result:
+        result = _salvage(result, rc,
+                          f"cpu child rc={rc} after {cpu_timeout}s budget")
         cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
         _emit(result, cifar_sps, extra={"tpu_error": "; ".join(diags)})
         return 0
