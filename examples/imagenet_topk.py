@@ -29,7 +29,6 @@ def main():
     from tpu_resnet import parallel
     from tpu_resnet.config import load_config
     from tpu_resnet.data.imagenet import eval_examples
-    from tpu_resnet.evaluation import build_eval_step
     from tpu_resnet.models import build_model
     from tpu_resnet.tools.predict import load_label_map
     from tpu_resnet.train import build_schedule

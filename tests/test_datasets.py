@@ -4,7 +4,6 @@ CIFAR-format records."""
 
 import hashlib
 import io
-import os
 import tarfile
 
 import numpy as np

@@ -5,7 +5,6 @@ import jax
 import numpy as np
 
 from tpu_resnet.config import load_config
-from tpu_resnet.data.cifar import synthetic_data
 from tpu_resnet.evaluation.evaluator import (
     _mesh_eval_batch,
     build_eval_step,
@@ -16,7 +15,6 @@ from tpu_resnet.parallel import create_mesh, replicated
 from tpu_resnet.train import build_schedule, init_state, train
 import jax.numpy as jnp
 
-from tpu_resnet.models import build_model
 
 
 def test_eval_batch_rounded_to_mesh():

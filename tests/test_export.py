@@ -8,7 +8,6 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpu_resnet.config import load_config
 from tpu_resnet.data.cifar import synthetic_data

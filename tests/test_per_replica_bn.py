@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from tpu_resnet.config import load_config
-from tpu_resnet.data.cifar import synthetic_data
 from tpu_resnet.models import build_model
 from tpu_resnet.parallel import batch_sharding, create_mesh, replicated
 from tpu_resnet.train import build_schedule, init_state, make_train_step

@@ -4,7 +4,7 @@ ps1workers1.csv role, SURVEY.md §2.2 results artifacts)."""
 import json
 import os
 
-from tpu_resnet.tools.plot_metrics import load_series, plot, write_csv
+from tpu_resnet.tools.plot_metrics import load_series, plot
 
 
 def _write_jsonl(path, records):

@@ -32,7 +32,7 @@ single-process meshes by ``should_use`` below.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -34,7 +34,7 @@ import io
 import os
 import queue
 import threading
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Tuple
 
 import numpy as np
 

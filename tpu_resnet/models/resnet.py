@@ -39,7 +39,6 @@ TPU-first deviations from the reference design (not behavior):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Optional, Sequence
 
 import flax.linen as nn

@@ -17,7 +17,6 @@ async-PS, Horovod — SURVEY.md §2.3): the mesh decides the distribution.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Optional
 
 import jax

@@ -4,7 +4,7 @@ resnet_cifar_train.py:275-311) with one immutable pytree."""
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 import flax.struct
 import jax
