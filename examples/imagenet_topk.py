@@ -11,19 +11,32 @@ The label file uses the same format the reference ships
 your own copy.
 """
 
-import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    ap = argparse.ArgumentParser(description=__doc__)
+    from tpu_resnet.config import build_arg_parser
+
+    ap = build_arg_parser(__doc__)
     ap.add_argument("--train-dir", required=True)
     ap.add_argument("--data-dir", required=True)
     ap.add_argument("--label-file", default="")
     ap.add_argument("--k", type=int, default=5)
     ap.add_argument("--num-images", type=int, default=8)
+    ap.set_defaults(preset="imagenet")
     args = ap.parse_args()
 
     import jax
+
+    # CPU by default like the sibling walkthroughs; EXAMPLE_PLATFORM=tpu
+    # (or empty for auto) runs on real chips.
+    platform = os.environ.get("EXAMPLE_PLATFORM", "cpu")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
     import numpy as np
 
     from tpu_resnet import parallel
@@ -35,7 +48,10 @@ def main():
     from tpu_resnet.train.checkpoint import CheckpointManager
     from tpu_resnet.train.state import init_state
 
-    cfg = load_config("imagenet")
+    cfg = load_config(args.preset, args.config, args.overrides)
+    if cfg.data.dataset != "imagenet":
+        raise SystemExit(f"this example reads ImageNet TFRecord shards; "
+                         f"dataset={cfg.data.dataset!r} is not supported")
     cfg.train.train_dir = args.train_dir
     cfg.data.data_dir = args.data_dir
     names = load_label_map(cfg, args.label_file)
@@ -44,15 +60,16 @@ def main():
     model = build_model(cfg)
     schedule = build_schedule(cfg.optim, cfg.train)
     import jax.numpy as jnp
+    size = cfg.data.resolved_image_size
     template = jax.device_put(
         init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
-                   jnp.zeros((1, 224, 224, 3))), parallel.replicated(mesh))
+                   jnp.zeros((1, size, size, 3))), parallel.replicated(mesh))
     ckpt = CheckpointManager(cfg.train.train_dir)
     state = ckpt.restore(template)
     print(f"restored checkpoint @ step {int(jax.device_get(state.step))}")
 
     from tpu_resnet.data.augment import get_augment_fns
-    _, eval_pre = get_augment_fns("imagenet")
+    _, eval_pre = get_augment_fns(cfg.data.dataset)
 
     @jax.jit
     def logits_fn(state, images):
@@ -60,7 +77,9 @@ def main():
             {"params": state.params, "batch_stats": state.batch_stats},
             eval_pre(images), train=False)
 
-    batch = next(iter(eval_examples(args.data_dir, args.num_images)))
+    batch = next(iter(eval_examples(args.data_dir, args.num_images,
+                                    image_size=size,
+                                    eval_resize=cfg.data.eval_resize)))
     images, labels = batch
     probs = jax.nn.softmax(logits_fn(state, images))
     top = np.argsort(-np.asarray(probs), axis=-1)[:, :args.k]

@@ -10,14 +10,15 @@ import sys
 import pytest
 
 
-def _run_example(name, tmp_path, timeout):
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = os.path.join(repo, "examples", name)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name, timeout, argv):
     env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU: the walkthrough's default
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU: the walkthroughs' default
     proc = subprocess.run(
-        [sys.executable, script, str(tmp_path / "work")],
-        env=env, cwd=repo, stdout=subprocess.PIPE,
+        [sys.executable, os.path.join(REPO, "examples", name)] + argv,
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True, timeout=timeout)
     assert proc.returncode == 0, proc.stdout[-3000:]
     return proc
@@ -25,7 +26,8 @@ def _run_example(name, tmp_path, timeout):
 
 @pytest.mark.slow
 def test_cifar_workflow_example(tmp_path):
-    proc = _run_example("cifar_workflow.py", tmp_path, timeout=540)
+    proc = _run_example("cifar_workflow.py", 540,
+                        [str(tmp_path / "work")])
     # Every advertised artifact exists.
     for sub in ("train", "frozen", "predictions"):
         assert (tmp_path / "work" / sub).is_dir(), sub
@@ -36,9 +38,45 @@ def test_cifar_workflow_example(tmp_path):
 def test_imagenet_workflow_example(tmp_path):
     """The ImageNet notebook-parity walkthrough: synthetic TFRecord shards
     → streaming-path training → export → label-mapped prediction."""
-    proc = _run_example("imagenet_workflow.py", tmp_path, timeout=540)
+    proc = _run_example("imagenet_workflow.py", 540,
+                        [str(tmp_path / "work")])
     for sub in ("data", "train", "frozen", "predictions"):
         assert (tmp_path / "work" / sub).is_dir(), sub
     assert "precision over" in proc.stdout
     assert (tmp_path / "work" / "predictions"
             / "predictions.json").exists()
+
+
+@pytest.mark.slow
+def test_imagenet_topk_example(tmp_path):
+    """The top-k prediction example (resnet_imagenet_predict.ipynb role)
+    runs against a checkpoint + shards + reference-format label map."""
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from imagenet_workflow import make_dataset, write_label_map
+
+    from tpu_resnet.config import load_config
+    from tpu_resnet.train import train
+
+    data_dir = str(tmp_path / "data")
+    train_dir = str(tmp_path / "train")
+    label_file = str(tmp_path / "labels.txt")
+    make_dataset(data_dir)
+    write_label_map(label_file)
+
+    overrides = ["data.data_dir=" + data_dir, "data.image_size=64",
+                 "data.eval_resize=72", "data.resize_min=72",
+                 "data.resize_max=96", "data.num_workers=2",
+                 "data.shuffle_buffer=64", "model.resnet_size=18",
+                 "model.compute_dtype=float32", "train.global_batch_size=8",
+                 "train.train_steps=2", "train.checkpoint_every=2",
+                 "train.train_dir=" + train_dir]
+    cfg = load_config("imagenet", overrides=overrides)
+    train(cfg)
+
+    proc = _run_example(
+        "imagenet_topk.py", 420,
+        ["--train-dir", train_dir, "--data-dir", data_dir,
+         "--label-file", label_file, "--k", "3", "--num-images", "4"]
+        + overrides)
+    assert "restored checkpoint @ step 2" in proc.stdout
+    assert "top1:" in proc.stdout and "class_" in proc.stdout

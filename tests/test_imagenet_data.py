@@ -53,8 +53,7 @@ def test_train_iterator_batches(tmp_path):
 
 def test_eval_examples_full_coverage_and_padding(tmp_path):
     want = make_shards(tmp_path, train=False, n_shards=2, per_shard=5)
-    batches = list(imagenet.eval_examples(str(tmp_path), batch=4,
-                                          num_workers=1))
+    batches = list(imagenet.eval_examples(str(tmp_path), batch=4))
     assert len(batches) == 3  # 10 examples → 4+4+2(+2 pad)
     labels = np.concatenate([lab for _, lab in batches])
     valid = labels[labels >= 0]
@@ -62,6 +61,23 @@ def test_eval_examples_full_coverage_and_padding(tmp_path):
     # 0-based labels match the 1-based shard labels
     assert sorted(valid.tolist()) == sorted(l - 1 for l in want)
     assert (labels[-2:] == -1).all()
+
+
+def test_eval_examples_honors_eval_resize(tmp_path):
+    """cfg.data.eval_resize must reach the decode (it used to be dropped:
+    a 64px eval with the 256 default resized 4x too far and center-cropped
+    ~6% of the image). With eval_resize == out_size the whole image
+    survives; with a much larger resize side only the center does."""
+    make_shards(tmp_path, train=False, n_shards=1, per_shard=1,
+                size=(100, 100))
+    def first(eval_resize):
+        img, _ = next(iter(imagenet.eval_examples(
+            str(tmp_path), batch=1, image_size=64,
+            eval_resize=eval_resize)))
+        return img[0]
+    tight = first(64)     # resize side 64 → crop = whole image
+    loose = first(256)    # resize side 256 → crop = center 25%
+    assert not np.array_equal(tight, loose)
 
 
 def test_decode_and_crop_train_and_eval():

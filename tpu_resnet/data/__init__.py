@@ -62,9 +62,9 @@ def eval_split_batches(data_cfg, batch: int,
     if data_cfg.dataset == "imagenet":
         from tpu_resnet.data.imagenet import eval_examples
         return eval_examples(data_cfg.data_dir, batch,
-                             num_workers=data_cfg.num_workers,
                              process_index=pi, process_count=pc,
                              image_size=data_cfg.resolved_image_size,
+                             eval_resize=data_cfg.eval_resize,
                              verify_records=data_cfg.verify_records)
     images, labels = load_split(data_cfg, train=False)
     return eval_batches(images[pi::pc], labels[pi::pc], batch)

@@ -163,7 +163,8 @@ class ImageNetIterator:
     def __init__(self, data_dir: str, local_batch: int, *, train: bool = True,
                  seed: int = 0, num_workers: int = 4,
                  shuffle_buffer: int = 4096, resize_min: int = 256,
-                 resize_max: int = 512, start_step: int = 0,
+                 resize_max: int = 512, eval_resize: int = EVAL_RESIZE,
+                 start_step: int = 0,
                  process_index: int = 0, process_count: int = 1,
                  image_size: int = IMAGE_SIZE, verify_records: bool = False):
         self.files = shard_files(data_dir, train)[process_index::process_count]
@@ -176,6 +177,7 @@ class ImageNetIterator:
         self.shuffle_buffer = shuffle_buffer
         self.resize_min = resize_min
         self.resize_max = resize_max
+        self.eval_resize = eval_resize
         self.image_size = image_size
         self.start_step = start_step
         self.verify_records = verify_records
@@ -339,6 +341,7 @@ class ImageNetIterator:
                     images[count] = decode_and_crop(
                         jpeg, self.train, rng,
                         self.resize_min, self.resize_max,
+                        eval_resize=self.eval_resize,
                         out_size=self.image_size)
                     labels[count] = label - 1  # 1-based shard labels → 0-based
                     count += 1
@@ -367,27 +370,28 @@ class ImageNetIterator:
                 out_q.get_nowait()
 
 
-def eval_examples(data_dir: str, batch: int, *, num_workers: int = 4,
+def eval_examples(data_dir: str, batch: int, *,
                   process_index: int = 0, process_count: int = 1,
-                  image_size: int = IMAGE_SIZE, verify_records: bool = False
+                  image_size: int = IMAGE_SIZE,
+                  eval_resize: int = EVAL_RESIZE,
+                  verify_records: bool = False
                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Sequential eval pass with zero-padded final batch (labels=-1 mark
     padding, mirroring pipeline.eval_batches)."""
-    it = ImageNetIterator(data_dir, batch, train=False,
-                          num_workers=num_workers,
-                          process_index=process_index,
-                          process_count=process_count,
-                          image_size=image_size)
+    files = shard_files(data_dir, train=False)[process_index::process_count]
+    if not files:
+        raise ValueError("fewer validation shard files than processes")
     rng = np.random.default_rng(0)
     images = np.empty((batch, image_size, image_size, 3), np.uint8)
     labels = np.full((batch,), -1, np.int32)
     count = 0
     if Image is None:
         raise RuntimeError("PIL is required for ImageNet decoding")
-    for f in it.files:
+    for f in files:
         for rec in read_shard_records(f, verify_crc=verify_records):
             jpeg, label = parse_record(rec)
             images[count] = decode_and_crop(jpeg, False, rng,
+                                            eval_resize=eval_resize,
                                             out_size=image_size)
             labels[count] = label - 1
             count += 1
