@@ -80,6 +80,66 @@ def test_eval_examples_honors_eval_resize(tmp_path):
     assert not np.array_equal(tight, loose)
 
 
+def test_iterator_native_planes_equivalent(tmp_path):
+    """Both data planes must produce the same stream through the full
+    iterator — the decoders are documented as interchangeable
+    per-image."""
+    from tpu_resnet.native import jpeg_available
+
+    if not jpeg_available():  # same convention as tests/test_native.py
+        pytest.skip("built without libjpeg — both paths would be PIL")
+    make_shards(tmp_path, n_shards=2, per_shard=4, train=True)
+
+    def batch(use_native):
+        it = iter(imagenet.ImageNetIterator(
+            str(tmp_path), local_batch=4, train=True, num_workers=1,
+            shuffle_buffer=8, seed=1, use_native=use_native))
+        return next(it)
+
+    img_n, lab_n = batch(True)
+    img_p, lab_p = batch(False)
+    np.testing.assert_array_equal(lab_n, lab_p)
+    # same parity contract as tests/test_native.py: libjpeg and PIL may
+    # differ by rounding, never structurally
+    diff = np.abs(img_n.astype(np.int16) - img_p.astype(np.int16))
+    assert diff.max() <= 2, f"max diff {diff.max()}"
+
+
+def test_use_native_loader_reaches_imagenet_chain(tmp_path, monkeypatch):
+    """data.use_native_loader must flow from the config through
+    train_batches and eval_split_batches (it used to stop at the CIFAR
+    path)."""
+    import tpu_resnet.data as data_lib
+    from tpu_resnet.config import DataConfig
+
+    make_shards(tmp_path, n_shards=2, per_shard=4, train=True)
+    make_shards(tmp_path, n_shards=1, per_shard=2, train=False)
+    cfg = DataConfig(dataset="imagenet", data_dir=str(tmp_path),
+                     use_native_loader=False)
+
+    seen = {}
+    real_iter = imagenet.ImageNetIterator
+    real_eval = imagenet.eval_examples
+
+    def spy_iter(*a, **kw):
+        seen["train"] = kw
+        return real_iter(*a, **kw)
+
+    def spy_eval(*a, **kw):
+        seen["eval"] = kw
+        return real_eval(*a, **kw)
+
+    monkeypatch.setattr(data_lib.imagenet, "ImageNetIterator", spy_iter)
+    monkeypatch.setattr(data_lib.imagenet, "eval_examples", spy_eval)
+
+    next(data_lib.train_batches(cfg, local_batch=2))
+    next(iter(data_lib.eval_split_batches(cfg, batch=2,
+                                          process_index=0,
+                                          process_count=1)))
+    assert seen["train"]["use_native"] is False
+    assert seen["eval"]["use_native"] is False
+
+
 def test_decode_and_crop_train_and_eval():
     rng = np.random.default_rng(0)
     arr = np.zeros((300, 400, 3), np.uint8)

@@ -38,7 +38,8 @@ def train_batches(data_cfg, local_batch: int, seed: int = 0,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
             image_size=data_cfg.resolved_image_size,
-            verify_records=data_cfg.verify_records))
+            verify_records=data_cfg.verify_records,
+            use_native=data_cfg.use_native_loader))
     images, labels = load_split(data_cfg, train=True)
     return iter(ShardedBatcher(images, labels, local_batch, seed=seed,
                                start_step=start_step))
@@ -65,6 +66,7 @@ def eval_split_batches(data_cfg, batch: int,
                              process_index=pi, process_count=pc,
                              image_size=data_cfg.resolved_image_size,
                              eval_resize=data_cfg.eval_resize,
-                             verify_records=data_cfg.verify_records)
+                             verify_records=data_cfg.verify_records,
+                             use_native=data_cfg.use_native_loader)
     images, labels = load_split(data_cfg, train=False)
     return eval_batches(images[pi::pc], labels[pi::pc], batch)

@@ -166,7 +166,8 @@ class ImageNetIterator:
                  resize_max: int = 512, eval_resize: int = EVAL_RESIZE,
                  start_step: int = 0,
                  process_index: int = 0, process_count: int = 1,
-                 image_size: int = IMAGE_SIZE, verify_records: bool = False):
+                 image_size: int = IMAGE_SIZE, verify_records: bool = False,
+                 use_native: bool = True):
         self.files = shard_files(data_dir, train)[process_index::process_count]
         if not self.files:
             raise ValueError("fewer shard files than processes")
@@ -181,6 +182,7 @@ class ImageNetIterator:
         self.image_size = image_size
         self.start_step = start_step
         self.verify_records = verify_records
+        self.use_native = use_native
         self._findex: dict = {}
         self._read_f = None
         self._read_path = None
@@ -192,7 +194,8 @@ class ImageNetIterator:
                      else list(self.files))
             for f in files:
                 for rec in read_shard_records(
-                        f, verify_crc=self.verify_records):
+                        f, use_native=self.use_native,
+                        verify_crc=self.verify_records):
                     yield rec
             if not self.train:
                 return
@@ -306,7 +309,8 @@ class ImageNetIterator:
                         r0 = 0
                     else:  # whole shards go through the bulk reader
                         yield from read_shard_records(
-                            efiles[k], verify_crc=self.verify_records)
+                            efiles[k], use_native=self.use_native,
+                            verify_crc=self.verify_records)
                 e, f0 = e + 1, 0
 
         yield from self._shuffle_stream(rest(), rng, buf)
@@ -342,7 +346,8 @@ class ImageNetIterator:
                         jpeg, self.train, rng,
                         self.resize_min, self.resize_max,
                         eval_resize=self.eval_resize,
-                        out_size=self.image_size)
+                        out_size=self.image_size,
+                        use_native=self.use_native)
                     labels[count] = label - 1  # 1-based shard labels → 0-based
                     count += 1
                 if count == self.local_batch:
@@ -374,7 +379,7 @@ def eval_examples(data_dir: str, batch: int, *,
                   process_index: int = 0, process_count: int = 1,
                   image_size: int = IMAGE_SIZE,
                   eval_resize: int = EVAL_RESIZE,
-                  verify_records: bool = False
+                  verify_records: bool = False, use_native: bool = True
                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Sequential eval pass with zero-padded final batch (labels=-1 mark
     padding, mirroring pipeline.eval_batches)."""
@@ -388,11 +393,13 @@ def eval_examples(data_dir: str, batch: int, *,
     if Image is None:
         raise RuntimeError("PIL is required for ImageNet decoding")
     for f in files:
-        for rec in read_shard_records(f, verify_crc=verify_records):
+        for rec in read_shard_records(f, use_native=use_native,
+                                      verify_crc=verify_records):
             jpeg, label = parse_record(rec)
             images[count] = decode_and_crop(jpeg, False, rng,
                                             eval_resize=eval_resize,
-                                            out_size=image_size)
+                                            out_size=image_size,
+                                            use_native=use_native)
             labels[count] = label - 1
             count += 1
             if count == batch:
