@@ -1,0 +1,47 @@
+"""``tpu_resnet doctor`` — environment triage (tpu_resnet/tools/doctor.py).
+
+The backend probe runs against the ambient environment, which in CI may
+have a wedged plugin — the tests assert the doctor *reports* (quickly,
+with a timeout) rather than hangs, and that the backend-independent
+checks are correct.
+"""
+
+import io
+import json
+
+from tpu_resnet.tools import doctor
+
+
+def test_doctor_runs_and_reports(tmp_path):
+    buf = io.StringIO()
+    summary = doctor.run_doctor(probe_timeout=1, mesh_devices=4, stream=buf)
+    out = buf.getvalue()
+    # one line per check + a final machine-readable summary line
+    for name in ("versions", "backend", "cpu_mesh", "native"):
+        assert f"[doctor] {name}" in out
+        assert name in summary
+    assert summary["versions"]["jax"][0].isdigit()
+    # the CPU mesh smoke must pass anywhere (clean scrubbed subprocess)
+    assert summary["cpu_mesh"] == {"ok": True, "devices": 4}
+    parsed = json.loads(out.rsplit("DOCTOR_JSON: ", 1)[1])
+    assert parsed["ok"] == summary["ok"]
+
+
+def test_doctor_dataset_layout(tmp_path):
+    good = doctor._check_dataset("cifar10", str(tmp_path))
+    assert not good["ok"]  # empty dir: loud failure with the reason
+    assert "error" in good
+
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    for i in range(1, 6):
+        (d / f"data_batch_{i}.bin").write_bytes(b"\0" * 3073)
+    (d / "test_batch.bin").write_bytes(b"\0" * 3073)
+    assert doctor._check_dataset("cifar10", str(tmp_path))["ok"]
+
+
+def test_doctor_dataset_layout_imagenet(tmp_path):
+    assert not doctor._check_dataset("imagenet", str(tmp_path))["ok"]
+    (tmp_path / "train-00000-of-00001").write_bytes(b"")
+    (tmp_path / "validation-00000-of-00001").write_bytes(b"")
+    assert doctor._check_dataset("imagenet", str(tmp_path))["ok"]

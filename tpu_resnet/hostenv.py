@@ -1,0 +1,36 @@
+"""Process-environment helpers that must not import jax.
+
+Used by the driver entry (`__graft_entry__`), `bench.py`'s no-jax parent
+orchestrator, and `tpu_resnet doctor` — all of which spawn clean
+subprocesses while the ambient process may have a wedged TPU plugin.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scrubbed_cpu_env(n_devices: int) -> dict:
+    """A copy of the environment with the CPU platform forced and every
+    TPU/backend-selection knob stripped, so a child process can only ever
+    initialize the virtual-device CPU backend.
+
+    This includes dropping any sitecustomize-style PJRT plugin hooks from
+    PYTHONPATH: a TPU plugin that registers itself at interpreter startup
+    can hang a process that never asked for TPU devices (observed: with
+    ``JAX_PLATFORMS=cpu`` set at startup the ambient plugin hook still
+    blocks on its transport; without the hook on PYTHONPATH, CPU-only
+    startup takes ~2 s)."""
+    env = dict(os.environ)
+    for key in list(env):
+        if key.startswith(("TPU_", "LIBTPU", "PJRT_", "CLOUD_TPU",
+                           "AXON_", "PALLAS_AXON_")):
+            del env[key]
+    pypath = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+              if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
+    env["PYTHONPATH"] = os.pathsep.join([_REPO_ROOT] + pypath)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env

@@ -38,9 +38,11 @@ def main(argv=None):
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
+        ("doctor", "environment triage: backend probe, CPU mesh smoke, "
+                   "native plane, dataset layout"),
     ]:
         p = sub.add_parser(name, help=help_text)
-        if name != "fetch":  # fetch takes a dataset name, not a run config
+        if name not in ("fetch", "doctor"):  # these take no run config
             p.add_argument("--preset", default="")
             p.add_argument("--config", default="")
             p.add_argument("overrides", nargs="*")
@@ -74,12 +76,27 @@ def main(argv=None):
                            choices=["cifar10", "cifar100", "imagenet"])
             p.add_argument("--out", required=True, help="dataset directory")
             p.add_argument("--keep-archive", action="store_true")
+        if name == "doctor":
+            p.add_argument("--dataset", default="",
+                           help="with --data-dir: layout to validate")
+            p.add_argument("--data-dir", default="")
+            p.add_argument("--probe-timeout", type=int, default=60)
+            p.add_argument("--mesh-devices", type=int, default=8)
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
         from tpu_resnet.tools.datasets import fetch
         fetch(args.dataset, args.out, keep_archive=args.keep_archive)
         return 0
+
+    if args.command == "doctor":
+        from tpu_resnet.tools.doctor import run_doctor
+        if args.dataset and not args.data_dir:
+            parser.error("doctor --dataset requires --data-dir")
+        summary = run_doctor(dataset=args.dataset, data_dir=args.data_dir,
+                             probe_timeout=args.probe_timeout,
+                             mesh_devices=args.mesh_devices)
+        return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config
     cfg = load_config(args.preset, args.config, args.overrides)

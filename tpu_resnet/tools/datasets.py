@@ -85,6 +85,12 @@ def extract_archive(archive: str, out_dir: str, member_prefix: str) -> str:
 
 def validate_layout(dataset: str, data_dir: str) -> None:
     """The loader's own file resolution is the layout check."""
+    if dataset == "imagenet":
+        from tpu_resnet.data.imagenet import shard_files
+
+        for train in (True, False):
+            shard_files(data_dir, train)
+        return
     from tpu_resnet.data.cifar import cifar_files
 
     for train in (True, False):

@@ -1,0 +1,153 @@
+"""Environment triage — ``python -m tpu_resnet doctor``.
+
+The reference assumes a working cluster and fails with raw stack traces
+when it isn't (e.g. a dead gRPC peer hangs the session, reference
+resnet_cifar_train.py:330-344). On TPU the equivalent operational hazards
+are a wedged PJRT plugin (backend init that blocks forever with no
+message), a missing native data plane, and a dataset directory that
+doesn't match the expected layout. ``doctor`` checks each one with
+timeouts and prints one line per check plus a final machine-readable JSON
+summary — the triage an operator runs before filing the train job.
+
+Checks:
+  versions   python/jax/jaxlib/flax/optax/orbax versions
+  backend    device probe in a short-timeout subprocess (a hanging
+             plugin costs seconds, not a hung job); platform, device
+             kind, device count
+  cpu_mesh   virtual multi-device CPU mesh + one jitted SPMD reduction
+             (proves the sharding machinery without an accelerator)
+  native     C++ data plane: built? JPEG decode enabled? (attempts a
+             lazy build exactly like first use does)
+  dataset    optional --data-dir layout validation (CIFAR binary names /
+             ImageNet shard pattern)
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+_PROBE = ("import jax; d = jax.devices(); "
+          "print('PROBE', jax.default_backend(), '|', d[0].platform, '|', "
+          "d[0].device_kind, '|', len(d))")
+
+
+def _check_versions() -> dict:
+    import importlib
+
+    out = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint"):
+        try:
+            m = importlib.import_module(mod)
+            out[mod] = getattr(m, "__version__", "?")
+        except Exception as e:  # pragma: no cover - env-specific
+            out[mod] = f"import failed: {type(e).__name__}"
+    return out
+
+
+def _check_backend(timeout: int) -> dict:
+    """Probe the ambient backend in a subprocess so a wedged PJRT plugin
+    (round-1 failure mode: init blocks forever at ~0 CPU) is reported as
+    a timeout instead of hanging the doctor."""
+    try:
+        proc = subprocess.run([sys.executable, "-c", _PROBE],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"backend init hung for {timeout}s — plugin/"
+                         f"tunnel wedged (round-1 failure mode); "
+                         f"set JAX_PLATFORMS=cpu to work locally"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        if line.startswith("PROBE "):
+            backend, platform, kind, n = (
+                p.strip() for p in line[len("PROBE "):].split("|"))
+            return {"ok": True, "backend": backend, "platform": platform,
+                    "device_kind": kind, "devices": int(n)}
+    return {"ok": False, "rc": proc.returncode,
+            "tail": proc.stdout.strip().splitlines()[-3:]}
+
+
+def _check_cpu_mesh(n_devices: int, timeout: int) -> dict:
+    """Virtual CPU mesh + one jitted psum-style reduction in a clean
+    subprocess (same env scrub as dryrun_multichip)."""
+    from tpu_resnet.hostenv import _REPO_ROOT
+    from tpu_resnet.hostenv import scrubbed_cpu_env as _cpu_env
+
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "import numpy as np\n"
+        f"devs = jax.devices()[:{n_devices}]\n"
+        "mesh = Mesh(np.asarray(devs).reshape(-1, 1), ('data', 'model'))\n"
+        "x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P('data')))\n"
+        "s = jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)\n"
+        "print('MESH_OK', len(devs), float(s))\n")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              env=_cpu_env(n_devices),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout, cwd=_REPO_ROOT)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"CPU mesh smoke hung for {timeout}s"}
+    ok = False
+    for line in proc.stdout.splitlines():  # stderr is merged in; scan for
+        if line.startswith("MESH_OK"):     # the marker line specifically
+            ok = abs(float(line.split()[-1]) - 120.0) < 1e-6
+            break
+    out = {"ok": ok, "devices": n_devices}
+    if not ok:
+        out["tail"] = proc.stdout.strip().splitlines()[-3:]
+    return out
+
+
+def _check_native() -> dict:
+    try:
+        from tpu_resnet.native import available, jpeg_available
+        return {"ok": bool(available()), "built": bool(available()),
+                "jpeg": bool(jpeg_available())}
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def _check_dataset(dataset: str, data_dir: str) -> dict:
+    from tpu_resnet.tools.datasets import validate_layout
+
+    try:
+        validate_layout(dataset, data_dir)
+        return {"ok": True, "dataset": dataset, "data_dir": data_dir}
+    except Exception as e:
+        return {"ok": False, "dataset": dataset,
+                "error": f"{type(e).__name__}: {e}"}
+
+
+def run_doctor(dataset: str = "", data_dir: str = "",
+               probe_timeout: int = 60, mesh_devices: int = 8,
+               stream=None) -> dict:
+    """Run all checks; print human lines to ``stream`` (default stdout),
+    return the summary dict (also printed as one final JSON line)."""
+    stream = stream or sys.stdout
+
+    def emit(name, result):
+        status = "ok" if result.get("ok", True) else "FAIL"
+        detail = {k: v for k, v in result.items() if k != "ok"}
+        print(f"[doctor] {name:10s} {status}  {detail}", file=stream)
+
+    summary = {"versions": _check_versions()}
+    emit("versions", summary["versions"])
+    summary["backend"] = _check_backend(probe_timeout)
+    emit("backend", summary["backend"])
+    summary["cpu_mesh"] = _check_cpu_mesh(mesh_devices, timeout=300)
+    emit("cpu_mesh", summary["cpu_mesh"])
+    summary["native"] = _check_native()
+    emit("native", summary["native"])
+    if data_dir:
+        summary["dataset"] = _check_dataset(dataset or "cifar10", data_dir)
+        emit("dataset", summary["dataset"])
+    summary["ok"] = all(v.get("ok", True) for v in summary.values()
+                        if isinstance(v, dict))
+    print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)
+    return summary
