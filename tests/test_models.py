@@ -155,3 +155,18 @@ def test_mlp_shapes():
     assert logits.shape == (3, 10)
     n = param_count(variables["params"])
     assert n == (32 * 32 * 3 * 100 + 100) + (100 * 10 + 10)
+
+
+def test_layer_params_table_sums_to_total():
+    """The tfprof-style per-parameter dump (info --layers) must cover every
+    leaf exactly once."""
+    from tpu_resnet.tools.analysis import layer_params
+
+    model = cifar_resnet_v2(14, 10, dtype=jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    rows = layer_params(variables["params"])
+    assert sum(c for _, _, c in rows) == param_count(variables["params"])
+    names = [n for n, _, _ in rows]
+    assert len(names) == len(set(names))  # unique, fully-qualified paths
+    assert any(n.startswith("initial_conv/") for n in names)

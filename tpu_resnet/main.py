@@ -49,6 +49,9 @@ def main(argv=None):
         if name == "eval":
             p.add_argument("--once", action="store_true",
                            help="evaluate latest checkpoint once and exit")
+        if name == "info":
+            p.add_argument("--layers", action="store_true",
+                           help="per-parameter table (tfprof-style dump)")
         if name == "export":
             p.add_argument("--out", required=True,
                            help="output directory for the frozen artifact")
@@ -126,7 +129,7 @@ def main(argv=None):
 
     if args.command == "info":
         from tpu_resnet.tools.analysis import print_model_info
-        print_model_info(cfg)
+        print_model_info(cfg, layers=args.layers)
         return 0
 
     if args.command == "export":

@@ -29,7 +29,27 @@ def forward_cost_analysis(model, image_size: int, batch: int = 1):
     return cost or {}
 
 
-def print_model_info(cfg: RunConfig):
+def layer_params(params) -> list:
+    """(path, shape, count) per parameter leaf, in module-definition order
+    — the reference's tfprof per-variable dump (resnet_single.py:58-66).
+    Walks the mapping directly because jax's tree flatten sorts keys
+    lexicographically (block10 before block2, final_dense before
+    initial_conv), which is not architecture order."""
+    rows = []
+
+    def walk(node, prefix):
+        if hasattr(node, "items"):  # dict / FrozenDict
+            for k, v in node.items():
+                walk(v, prefix + [str(k)])
+        else:
+            rows.append(("/".join(prefix), tuple(node.shape),
+                         int(node.size)))
+
+    walk(params, [])
+    return rows
+
+
+def print_model_info(cfg: RunConfig, layers: bool = False):
     model = build_model(cfg)
     size = cfg.data.resolved_image_size
     variables = model.init(jax.random.PRNGKey(0),
@@ -41,6 +61,12 @@ def print_model_info(cfg: RunConfig):
           f"width={cfg.model.width_multiplier} dataset={cfg.data.dataset}")
     print(f"trainable params: {n_params:,}")
     print(f"batch-norm moving stats: {n_stats:,}")
+    if layers:
+        rows = layer_params(variables["params"])
+        width = max(len(r[0]) for r in rows)
+        for name, shape, count in rows:
+            print(f"  {name:<{width}}  {str(shape):>20}  {count:>12,}")
+        print(f"  {'total':<{width}}  {'':>20}  {n_params:>12,}")
     try:
         cost = forward_cost_analysis(model, size)
         flops = cost.get("flops")
