@@ -20,10 +20,13 @@ Synthetic data is used so the benchmark needs no dataset download; the
 compute path is identical.
 
 Robustness (round-1 postmortem: the TPU plugin hung/failed and the bench
-died with a raw traceback and no JSON): the parent process never imports
-jax. It probes the TPU backend in a short-timeout subprocess, retries with
-backoff, runs the measurement in a child process, and on unrecoverable TPU
-failure falls back to a small CPU measurement clearly labeled
+died with a raw traceback and no JSON; round-2 postmortem: the tunnel was
+down at the driver's capture time but live mid-round): the parent process
+never imports jax. It WATCHES for the backend — cheap short-timeout
+probes polled across ``BENCH_WATCH_WINDOW`` seconds (default 3600) — and
+runs the measurement child the moment a probe succeeds, so a flaky
+tunnel's live window is caught rather than forfeited. On an exhausted
+window it falls back to a small CPU measurement clearly labeled
 ``"backend": "cpu"`` — emitting exactly one JSON line in every case.
 
     python bench.py                 # orchestrate (the driver's entry)
@@ -145,6 +148,13 @@ def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
     results = {}
     step = 0
     for k, warmup_chunks, measure_chunks in plans:
+        if warmup_chunks < 1:
+            raise ValueError(f"plan k={k}: warmup_chunks must be >= 1 "
+                             "(the timed loop reads the warmed metrics)")
+        if measure_chunks < 1:
+            raise ValueError(f"plan k={k}: measure_chunks must be >= 1 "
+                             "(zero measured chunks would report 0 st/s "
+                             "as a real number)")
         if (warmup_chunks + measure_chunks) * k > spe:
             raise ValueError(f"plan k={k} spans more than one epoch")
         step = -(-step // spe) * spe  # align to the next epoch boundary
@@ -634,43 +644,98 @@ def _salvage(result, rc, how_died):
     return result
 
 
+def _completeness(result):
+    """How many measurement sections a TPU snapshot completed — used to
+    prefer the most complete snapshot across child attempts."""
+    meta = {"backend", "device_kind", "n_devices", "errors", "partial"}
+    return len([k for k in result if k not in meta])
+
+
+def _emit_tpu(result, rc, how_died):
+    result = _salvage(dict(result), rc, how_died)
+    cifar = result.pop("cifar", {})
+    if len(cifar) > 1:  # keep per-k detail beside the headline
+        result["cifar_detail"] = cifar
+    _emit(result, cifar.get("steps_per_sec"))
+
+
 def main():
-    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    """Long-window watcher orchestration (round-2 postmortem: the tunnel to
+    the chip flaps, with live windows the old fixed two-probe schedule
+    missed entirely — BENCH_r02 forfeited to a CPU fallback while a live
+    window mid-round had measured 206+ steps/s). Poll with cheap
+    short-timeout probes across ``BENCH_WATCH_WINDOW`` seconds and run the
+    measurement child the moment the backend is live. A clean child emits
+    immediately; a crashed/timed-out child's partial snapshot is kept as a
+    fallback but retried while window and attempts remain, preferring the
+    most complete snapshot across attempts."""
+    max_children = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "75"))
+    poll_sleep = int(os.environ.get("BENCH_POLL_SLEEP", "45"))
     child_timeout = int(os.environ.get("BENCH_CHILD_TIMEOUT", "2100"))
-    backoffs = [20, 60, 120]
+    window = int(os.environ.get("BENCH_WATCH_WINDOW", "3600"))
+    deadline = time.time() + window
     diags = []
+    best = None         # (completeness, result, rc, how_died)
+    children = probes = 0
 
     me = os.path.abspath(__file__)
-    for attempt in range(attempts):
-        if attempt:
-            delay = backoffs[min(attempt - 1, len(backoffs) - 1)]
-            print(f"[bench] retrying TPU in {delay}s", file=sys.stderr)
-            time.sleep(delay)
+    while time.time() < deadline and children < max_children:
         ok, diag = _probe_tpu(probe_timeout)
-        diags.append(f"probe{attempt}: {diag}")
-        print(f"[bench] TPU probe attempt {attempt}: "
-              f"{'ok' if ok else 'FAILED'} ({diag})", file=sys.stderr)
+        probes += 1
+        if len(diags) < 40:
+            diags.append(f"probe{probes}: {diag}")
+        remain = int(deadline - time.time())
+        print(f"[bench] probe {probes}: {'ok' if ok else 'down'} ({diag}); "
+              f"window remaining {remain}s", file=sys.stderr)
         if not ok:
-            continue
+            if time.time() + poll_sleep < deadline:
+                time.sleep(poll_sleep)
+                continue
+            break
+        children += 1
         rc, out = _run([sys.executable, me, "--child", "tpu"],
                        dict(os.environ), child_timeout)
         sys.stderr.write(out)
         result = _parse_result(out)
-        if result:
-            result = _salvage(result, rc,
-                              f"tpu child rc={rc} after {child_timeout}s "
-                              f"budget")
-            cifar = result.pop("cifar", {})
-            if len(cifar) > 1:  # keep per-k detail beside the headline
-                result["cifar_detail"] = cifar
-            _emit(result, cifar.get("steps_per_sec"))
+        if result and rc == 0:
+            _emit_tpu(result, rc, "clean")
             return 0
-        diags.append(f"child{attempt}: rc={rc}, tail="
+        how = f"tpu child rc={rc} after {child_timeout}s budget"
+        diags.append(f"child{children}: rc={rc}, tail="
                      + " | ".join(out.strip().splitlines()[-3:]))
+        if result:
+            score = _completeness(result)
+            print(f"[bench] child {children} died ({how}) with "
+                  f"{score} sections complete — "
+                  f"{'kept' if not best or score > best[0] else 'dropped'}",
+                  file=sys.stderr)
+            if not best or score > best[0]:
+                best = (score, result, rc, how)
+        # Space out child retries: a fast-crashing child (probe ok,
+        # init dies in seconds) must not burn every attempt in the first
+        # two minutes of a one-hour window.
+        if children < max_children:
+            delay = [60, 180, 300][min(children - 1, 2)]
+            if time.time() + delay < deadline:
+                print(f"[bench] next child attempt in {delay}s",
+                      file=sys.stderr)
+                time.sleep(delay)
+
+    if best:
+        # Window/attempts exhausted: the most complete partial snapshot
+        # still beats a CPU fallback.
+        _emit_tpu(best[1], best[2], best[3])
+        return 0
 
     # Unrecoverable TPU failure: labeled CPU fallback so the round still
-    # records a live number plus the TPU diagnostics.
+    # records a live number plus the TPU diagnostics. An outer watcher
+    # (tools/tpu_battery.sh) disables the fallback — it re-polls for a
+    # live window itself instead of burning the core on a CPU measurement.
+    if os.environ.get("BENCH_CPU_FALLBACK", "1") == "0":
+        _emit({"backend": "none",
+               "error": ("; ".join(diags))[:2000]}, None)
+        return 1
     print("[bench] TPU unavailable — CPU fallback", file=sys.stderr)
     from __graft_entry__ import _cpu_env
     cpu_timeout = max(600, child_timeout // 2)
@@ -682,7 +747,8 @@ def main():
         result = _salvage(result, rc,
                           f"cpu child rc={rc} after {cpu_timeout}s budget")
         cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
-        _emit(result, cifar_sps, extra={"tpu_error": "; ".join(diags)})
+        _emit(result, cifar_sps,
+              extra={"tpu_error": ("; ".join(diags))[:2000]})
         return 0
     diags.append(f"cpu child: rc={rc}, tail="
                  + " | ".join(out.strip().splitlines()[-3:]))

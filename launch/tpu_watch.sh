@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# TPU tunnel watcher — the builder-session companion to `bench.py`'s own
+# long-window probe loop. The axon-attached chip flaps (round-2 postmortem:
+# live windows of ~30 min separated by hours); this loop polls cheaply and
+# fires the measurement battery the moment `jax.devices()` succeeds, so a
+# live window is never wasted on human reaction time.
+#
+#   launch/tpu_watch.sh [outdir] [deadline_epoch]
+#
+# Probes in a short-timeout subprocess (a down tunnel blocks jax.devices()
+# forever with ~0 CPU — never probe in-process). On success runs
+# `tools/tpu_battery.sh`, which archives results under docs/runs/ and
+# leaves a DONE marker; the watcher exits after one successful battery.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="${1:-$REPO/docs/runs/watch_r3}"
+DEADLINE="${2:-$(($(date +%s) + 11 * 3600))}"
+PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT:-60}"
+SLEEP="${TPU_WATCH_SLEEP:-90}"
+mkdir -p "$OUT"
+
+echo "[watch] start $(date -u +%FT%TZ) deadline=$(date -u -d @"$DEADLINE" +%FT%TZ) out=$OUT"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if timeout "$PROBE_TIMEOUT" python -c \
+      "import jax; d=jax.devices(); print('LIVE', len(d), d[0].device_kind)" \
+      >>"$OUT/probe.log" 2>&1; then
+    echo "[watch] TPU LIVE at $(date -u +%FT%TZ) — running battery"
+    bash "$REPO/tools/tpu_battery.sh" "$OUT" 2>&1 | tee -a "$OUT/battery.log"
+    if [ -f "$OUT/DONE" ]; then
+      echo "[watch] battery complete $(date -u +%FT%TZ)"
+      exit 0
+    fi
+    echo "[watch] battery incomplete (window closed?) — resuming poll"
+  fi
+  sleep "$SLEEP"
+done
+echo "[watch] deadline reached without a complete battery"
+exit 1

@@ -24,6 +24,25 @@ def test_measure_cifar_multiplan_smoke(mesh):
     assert all(v > 0 for v in by_k.values())
 
 
+def test_measure_cifar_rejects_zero_warmup(mesh):
+    """warmup_chunks=0 must fail loudly at validation, not NameError in
+    the timed loop (advisor round-2 finding)."""
+    with pytest.raises(ValueError, match="warmup_chunks"):
+        bench._measure_cifar(mesh, [(2, 0, 2)], resnet_size=8, batch=16,
+                             dtype="float32", split=256)
+
+
+def test_completeness_prefers_more_sections():
+    """Across crashed-child attempts the parent keeps the snapshot with
+    more completed measurement sections (advisor round-2 finding: a
+    partial on attempt 0 must not shadow a fuller later attempt)."""
+    partial = {"backend": "tpu", "device_kind": "x", "n_devices": 1,
+               "cifar": {"steps_per_sec": 1.0}, "errors": {"x": "y"}}
+    fuller = {"backend": "tpu", "device_kind": "x", "n_devices": 1,
+              "cifar": {"steps_per_sec": 1.0}, "imagenet": {"value": 2.0}}
+    assert bench._completeness(fuller) > bench._completeness(partial)
+
+
 def test_measure_cifar_wide_smoke(mesh):
     """The WRN entry's path: width multiplier + 100 classes."""
     by_k = bench._measure_cifar(mesh, [(2, 1, 1)], resnet_size=10,

@@ -27,6 +27,32 @@ def test_doctor_runs_and_reports(tmp_path):
     assert parsed["ok"] == summary["ok"]
 
 
+def test_doctor_cpu_mesh_non_divisor_devices():
+    """--mesh-devices values that don't divide 16 (the old hardcoded test
+    array) must still pass on a healthy environment (advisor round-2
+    finding)."""
+    assert doctor._check_cpu_mesh(3, timeout=300) == {"ok": True,
+                                                      "devices": 3}
+
+
+def test_doctor_versions_flags_broken_deps(monkeypatch):
+    """A core dep that fails to import must set ok=False so the overall
+    summary can't report healthy (advisor round-2 finding)."""
+    import importlib
+
+    real = importlib.import_module
+
+    def fake(mod, *a, **k):
+        if mod == "optax":
+            raise ImportError("boom")
+        return real(mod, *a, **k)
+
+    monkeypatch.setattr(importlib, "import_module", fake)
+    out = doctor._check_versions()
+    assert out["ok"] is False
+    assert "import failed" in out["optax"]
+
+
 def test_doctor_dataset_layout(tmp_path):
     good = doctor._check_dataset("cifar10", str(tmp_path))
     assert not good["ok"]  # empty dir: loud failure with the reason

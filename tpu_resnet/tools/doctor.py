@@ -36,13 +36,14 @@ _PROBE = ("import jax; d = jax.devices(); "
 def _check_versions() -> dict:
     import importlib
 
-    out = {"python": sys.version.split()[0]}
+    out = {"python": sys.version.split()[0], "ok": True}
     for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint"):
         try:
             m = importlib.import_module(mod)
             out[mod] = getattr(m, "__version__", "?")
         except Exception as e:  # pragma: no cover - env-specific
             out[mod] = f"import failed: {type(e).__name__}"
+            out["ok"] = False  # broken core dep must fail the summary
     return out
 
 
@@ -76,13 +77,16 @@ def _check_cpu_mesh(n_devices: int, timeout: int) -> dict:
     from tpu_resnet.hostenv import _REPO_ROOT
     from tpu_resnet.hostenv import scrubbed_cpu_env as _cpu_env
 
+    # Test array sized 2*n_devices so any --mesh-devices value divides it
+    # evenly (a fixed 16 failed healthy 3/5/6-device meshes).
     code = (
         "import jax, jax.numpy as jnp\n"
         "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
         "import numpy as np\n"
         f"devs = jax.devices()[:{n_devices}]\n"
         "mesh = Mesh(np.asarray(devs).reshape(-1, 1), ('data', 'model'))\n"
-        "x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P('data')))\n"
+        f"x = jax.device_put(jnp.arange({2 * n_devices}.0), "
+        "NamedSharding(mesh, P('data')))\n"
         "s = jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)\n"
         "print('MESH_OK', len(devs), float(s))\n")
     try:
@@ -94,9 +98,10 @@ def _check_cpu_mesh(n_devices: int, timeout: int) -> dict:
     except subprocess.TimeoutExpired:
         return {"ok": False, "error": f"CPU mesh smoke hung for {timeout}s"}
     ok = False
+    expect = float(n_devices * (2 * n_devices - 1))  # sum(0..2n-1)
     for line in proc.stdout.splitlines():  # stderr is merged in; scan for
         if line.startswith("MESH_OK"):     # the marker line specifically
-            ok = abs(float(line.split()[-1]) - 120.0) < 1e-6
+            ok = abs(float(line.split()[-1]) - expect) < 1e-6
             break
     out = {"ok": ok, "devices": n_devices}
     if not ok:
