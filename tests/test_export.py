@@ -102,3 +102,17 @@ def test_inspect_checkpoint(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "checkpoint step 4" in out
     assert "total elements" in out
+
+    # --peek: the exact restore_raw → flatten → lookup → stats path that
+    # crashed in round 1 (TypeError in PyTreeCheckpointer wiring) — now
+    # exercised directly, by full name and with a close-match miss.
+    peek_name = next(n for n in names if "initial_conv" in n
+                     and n.startswith("params"))
+    inspect_main(cfg.train.train_dir, peek=peek_name)
+    out = capsys.readouterr().out
+    assert f"{peek_name}: shape=" in out
+    assert "mean=" in out and "std=" in out
+
+    import pytest
+    with pytest.raises(KeyError, match="close matches"):
+        inspect_main(cfg.train.train_dir, peek="initial_conv")

@@ -34,6 +34,32 @@ def test_jsonl_and_tensorboard_roundtrip(tmp_path):
     assert seen[("precision", 20)] == 0.25
 
 
+def test_write_images_channels(tmp_path):
+    """Input-image summaries (reference cifar_input.py:118): TB image
+    event + the PNG grid fallback, with per-image display normalization
+    of standardized float input."""
+    import numpy as np
+
+    w = MetricsWriter(str(tmp_path))
+    imgs = np.random.default_rng(0).normal(size=(6, 8, 8, 3))  # float, ~N(0,1)
+    w.write_images(100, imgs, max_images=4)
+    w.close()
+
+    png = tmp_path / "images" / "input_images_step100.png"
+    assert png.exists()
+    from PIL import Image
+    grid = np.asarray(Image.open(png))
+    assert grid.shape == (8, 4 * 8, 3)  # 4 images side by side
+    assert grid.max() > 200 and grid.min() < 50  # min-max normalized
+
+    events = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    assert events
+    from tensorflow.compat.v1.train import summary_iterator
+    tags = {v.tag for ev in summary_iterator(events[0])
+            for v in ev.summary.value}
+    assert any("input_images" in t for t in tags)
+
+
 def test_disabled_writer_writes_nothing(tmp_path):
     w = MetricsWriter(str(tmp_path / "x"), enabled=False)
     w.write(1, {"loss": 1.0})

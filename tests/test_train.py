@@ -153,11 +153,17 @@ def test_train_loop_end_to_end(tmp_path):
     cfg.train.train_steps = 10
     cfg.train.checkpoint_every = 5
     cfg.train.log_every = 5
+    cfg.train.image_summary_every = 5  # input-image channel (cifar_input.py:118)
     cfg.train.global_batch_size = 16
     cfg.data.train_examples  # synthetic
     state = train(cfg)
     assert int(jax.device_get(state.step)) == 10
     assert latest_step_in(cfg.train.train_dir) == 10
+    import os
+    assert os.path.exists(os.path.join(cfg.train.train_dir, "images",
+                                       "input_images_step5.png"))
+    assert os.path.exists(os.path.join(cfg.train.train_dir, "images",
+                                       "input_images_step10.png"))
 
     # Resume: raising train_steps continues from the checkpoint.
     cfg.train.train_steps = 14

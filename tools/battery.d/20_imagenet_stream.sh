@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Streaming ImageNet train on the live TPU: the END-TO-END input-edge
+# measurement (VERDICT r2 item 2b) — TFRecord read → JPEG decode → VGG
+# preprocess → staged superbatch transfer → fused train step — over
+# synthetic photo-like shards, reported as sustained st/s next to the
+# synthetic-resident headline. Expected host-bound on this 1-core box
+# (~510 img/s/core decode vs ~3000 img/s consumed); the honest number +
+# the measured per-core decode rate IS the deliverable (host-count
+# budget: see docs/runs/input_edge_r3.json).
+set -eu
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+OUT="${1:-$REPO/docs/runs/watch_r3}"
+SHARDS=/tmp/imagenet_synth_shards
+RUN=/tmp/inet_stream_run_$$
+cd "$REPO"
+
+if [ ! -f "$SHARDS/.done" ]; then
+  echo "[imagenet_stream] generating synthetic shards"
+  mkdir -p "$SHARDS"
+  python - <<'EOF'
+import sys
+sys.path.insert(0, "tools")
+from input_edge import make_shards
+make_shards("/tmp/imagenet_synth_shards", n_shards=8, per_shard=96)
+make_shards("/tmp/imagenet_synth_shards", n_shards=2, per_shard=64,
+            train=False, seed=7)
+open("/tmp/imagenet_synth_shards/.done", "w").close()
+EOF
+fi
+
+echo "[imagenet_stream] streaming train run (40 steps b128)"
+timeout 1200 python -m tpu_resnet train --preset imagenet \
+  data.data_dir="$SHARDS" \
+  train.train_dir="$RUN" \
+  train.train_steps=40 train.log_every=10 train.checkpoint_every=40 \
+  train.image_summary_every=0 2>&1 | tail -20
+
+python - "$RUN" "$REPO/docs/runs/imagenet_stream_r3.json" <<'EOF'
+import json, sys
+recs = [json.loads(l) for l in open(sys.argv[1] + "/metrics.jsonl")]
+rates = [r["steps_per_sec"] for r in recs if "steps_per_sec" in r]
+out = {
+    "what": "streaming ImageNet ResNet-50 b128: host decode -> staged "
+            "superbatches -> fused step (synthetic photo shards)",
+    "steps_per_sec_by_log_point": [round(r, 3) for r in rates],
+    "sustained_steps_per_sec": round(rates[-1], 3) if rates else None,
+    "images_per_sec": round(rates[-1] * 128, 1) if rates else None,
+}
+json.dump(out, open(sys.argv[2], "w"), indent=2)
+print(json.dumps(out))
+EOF
+rm -rf "$RUN"

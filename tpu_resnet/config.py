@@ -182,6 +182,11 @@ class TrainConfig:
     eval_batch_size: int = 100  # reference resnet_cifar_eval.py: batch 100
     log_every: int = 20          # LoggingTensorHook interval (resnet_cifar_train.py:282-287)
     summary_every: int = 100     # SummarySaverHook interval (:275-280)
+    # Augmented input-batch image summaries (reference cifar_input.py:118
+    # wrote the training batch to TensorBoard with every summary). Here a
+    # small grid every N steps (0 = off); heavier than scalars, so the
+    # default matches the checkpoint cadence rather than summary_every.
+    image_summary_every: int = 1000
     checkpoint_every: int = 1000  # save_checkpoint_steps (:335)
     keep_checkpoints: int = 5
     seed: int = 0

@@ -26,14 +26,18 @@ def build(force: bool = False) -> str:
     tmp = f"{OUT}.tmp.{os.uname().nodename}.{os.getpid()}"
     base = [cxx, "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
             SRC, "-o", tmp]
-    # Prefer the JPEG-enabled build (native VGG decode path); fall back to
-    # record-framing-only when libjpeg headers/libs are absent.
+    # Build ladder: libjpeg-turbo partial decode (crop/skip — the fast
+    # path) → plain libjpeg → record-framing-only. Each rung compiles only
+    # if the previous one's API is unavailable.
+    turbo = base[:1] + ["-DTR_WITH_JPEG", "-DTR_TURBO_CROP"] + base[1:] \
+        + ["-ljpeg"]
     with_jpeg = base[:1] + ["-DTR_WITH_JPEG"] + base[1:] + ["-ljpeg"]
     try:
-        if subprocess.run(with_jpeg, capture_output=True).returncode != 0:
-            print("libjpeg unavailable; building record-framing-only loader",
-                  file=sys.stderr)
-            subprocess.run(base, check=True)
+        if subprocess.run(turbo, capture_output=True).returncode != 0:
+            if subprocess.run(with_jpeg, capture_output=True).returncode != 0:
+                print("libjpeg unavailable; building record-framing-only "
+                      "loader", file=sys.stderr)
+                subprocess.run(base, check=True)
         os.replace(tmp, OUT)
     finally:
         if os.path.exists(tmp):

@@ -189,6 +189,34 @@ void jpeg_err_exit(j_common_ptr cinfo) {
   longjmp(reinterpret_cast<JpegErr*>(cinfo->err)->jb, 1);
 }
 
+// One decompress struct per thread, reused across images (create/destroy
+// per image costs allocator round-trips; the iterator decodes millions).
+// An error longjmp destroys it and the next call recreates.
+struct TlDecoder {
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  bool init = false;
+
+  jpeg_decompress_struct* get() {
+    if (!init) {
+      cinfo.err = jpeg_std_error(&err.mgr);
+      err.mgr.error_exit = jpeg_err_exit;
+      jpeg_create_decompress(&cinfo);
+      init = true;
+    }
+    return &cinfo;
+  }
+  void fail() {  // called after longjmp: struct state is undefined
+    jpeg_destroy_decompress(&cinfo);
+    init = false;
+  }
+  ~TlDecoder() {
+    if (init) jpeg_destroy_decompress(&cinfo);
+  }
+};
+
+thread_local TlDecoder g_decoder;
+
 // Separable triangle-filter resize (support scaled by the downscale
 // factor — antialiased like PIL's BILINEAR, unlike 2-tap sampling) for
 // RGB uint8.
@@ -228,21 +256,34 @@ void precompute_axis(int in, int out, ResampleAxis& ax) {
   }
 }
 
-void resize_bilinear(const uint8_t* src, int w, int h, uint8_t* dst, int dw,
-                     int dh) {
+// Window-restricted resize: computes ONLY the output pixels
+// [x0, x0+cw) × [y0, y0+ch) of the virtual dw×dh resized image — the
+// weights are per-output-index, so the window's pixels are bit-identical
+// to a full resize followed by a crop, at a fraction of the work (the
+// crop is 224² out of up to 512²·aspect). ``src`` holds source columns
+// [src_x_off, src_x_off+w_buf) of rows [src_y_off, …) — the decoder only
+// materializes the span the window's taps touch.
+void resize_bilinear_window(const uint8_t* src, int w_full, int h_full,
+                            int w_buf, int src_x_off, int src_y_off,
+                            int dw, int dh, int x0, int y0, int cw, int ch,
+                            uint8_t* dst) {
   ResampleAxis hx, vx;
-  precompute_axis(w, dw, hx);
-  precompute_axis(h, dh, vx);
-  // Horizontal pass into a float intermediate (h × dw).
-  std::vector<float> tmp((size_t)h * dw * 3);
-  for (int y = 0; y < h; y++) {
-    const uint8_t* row = src + (size_t)y * w * 3;
-    float* orow = tmp.data() + (size_t)y * dw * 3;
-    for (int x = 0; x < dw; x++) {
-      const float* wt = &hx.weights[(size_t)x * hx.ksize];
-      const uint8_t* p = row + 3 * hx.first[x];
+  precompute_axis(w_full, dw, hx);
+  precompute_axis(h_full, dh, vx);
+  int row_lo = vx.first[y0], row_hi = 0;
+  for (int y = y0; y < y0 + ch; y++)
+    row_hi = std::max(row_hi, vx.first[y] + vx.count[y]);
+  // Horizontal pass into a float intermediate over just the needed rows
+  // and the cw output columns.
+  std::vector<float> tmp((size_t)(row_hi - row_lo) * cw * 3);
+  for (int y = row_lo; y < row_hi; y++) {
+    const uint8_t* row = src + (size_t)(y - src_y_off) * w_buf * 3;
+    float* orow = tmp.data() + (size_t)(y - row_lo) * cw * 3;
+    for (int x = 0; x < cw; x++) {
+      const float* wt = &hx.weights[(size_t)(x0 + x) * hx.ksize];
+      const uint8_t* p = row + 3 * (hx.first[x0 + x] - src_x_off);
       float r = 0, g = 0, b = 0;
-      for (int k = 0; k < hx.count[x]; k++, p += 3) {
+      for (int k = 0; k < hx.count[x0 + x]; k++, p += 3) {
         r += wt[k] * p[0];
         g += wt[k] * p[1];
         b += wt[k] * p[2];
@@ -252,18 +293,44 @@ void resize_bilinear(const uint8_t* src, int w, int h, uint8_t* dst, int dw,
       orow[3 * x + 2] = b;
     }
   }
-  // Vertical pass.
-  for (int y = 0; y < dh; y++) {
-    const float* wt = &vx.weights[(size_t)y * vx.ksize];
-    uint8_t* orow = dst + (size_t)y * dw * 3;
-    for (int x = 0; x < dw * 3; x++) {
+  // Vertical pass straight into the crop output.
+  for (int y = 0; y < ch; y++) {
+    const float* wt = &vx.weights[(size_t)(y0 + y) * vx.ksize];
+    uint8_t* orow = dst + (size_t)y * cw * 3;
+    const float* base =
+        tmp.data() + (size_t)(vx.first[y0 + y] - row_lo) * cw * 3;
+    for (int x = 0; x < cw * 3; x++) {
       float v = 0;
-      const float* col = tmp.data() + (size_t)vx.first[y] * dw * 3 + x;
-      for (int k = 0; k < vx.count[y]; k++, col += (size_t)dw * 3)
+      const float* col = base + x;
+      for (int k = 0; k < vx.count[y0 + y]; k++, col += (size_t)cw * 3)
         v += wt[k] * *col;
       orow[x] = (uint8_t)std::min(255.f, std::max(0.f, v + 0.5f));
     }
   }
+}
+
+// Source-pixel span the window's horizontal taps touch (for decode-time
+// column cropping) — recomputes the axis cheaply; decode dominates.
+void window_src_cols(int w_full, int dw, int x0, int cw, int* col_lo,
+                     int* col_hi) {
+  ResampleAxis hx;
+  precompute_axis(w_full, dw, hx);
+  *col_lo = hx.first[x0];
+  int hi = 0;
+  for (int x = x0; x < x0 + cw; x++)
+    hi = std::max(hi, hx.first[x] + hx.count[x]);
+  *col_hi = hi;
+}
+
+void window_src_rows(int h_full, int dh, int y0, int ch, int* row_lo,
+                     int* row_hi) {
+  ResampleAxis vx;
+  precompute_axis(h_full, dh, vx);
+  *row_lo = vx.first[y0];
+  int hi = 0;
+  for (int y = y0; y < y0 + ch; y++)
+    hi = std::max(hi, vx.first[y] + vx.count[y]);
+  *row_hi = hi;
 }
 
 }  // namespace
@@ -286,67 +353,96 @@ int32_t tr_decode_jpeg_vgg(const uint8_t* jpeg, int64_t len,
   (void)out;
   return -4;
 #else
-  jpeg_decompress_struct cinfo;
-  JpegErr err;
-  cinfo.err = jpeg_std_error(&err.mgr);
-  err.mgr.error_exit = jpeg_err_exit;
+  jpeg_decompress_struct* cinfo = g_decoder.get();
   std::vector<uint8_t> decoded;
-  if (setjmp(err.jb)) {
-    jpeg_destroy_decompress(&cinfo);
+  if (setjmp(g_decoder.err.jb)) {
+    g_decoder.fail();
     return -1;
   }
-  jpeg_create_decompress(&cinfo);
-  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(jpeg), (unsigned long)len);
-  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
-    jpeg_destroy_decompress(&cinfo);
+  jpeg_mem_src(cinfo, const_cast<uint8_t*>(jpeg), (unsigned long)len);
+  if (jpeg_read_header(cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_abort_decompress(cinfo);
     return -1;
   }
-  if (cinfo.jpeg_color_space == JCS_CMYK ||
-      cinfo.jpeg_color_space == JCS_YCCK) {
-    jpeg_destroy_decompress(&cinfo);
+  if (cinfo->jpeg_color_space == JCS_CMYK ||
+      cinfo->jpeg_color_space == JCS_YCCK) {
+    jpeg_abort_decompress(cinfo);
     return -2;  // rare; PIL fallback handles these
   }
-  cinfo.out_color_space = JCS_RGB;
+  cinfo->out_color_space = JCS_RGB;
   // DCT prescale: biggest 1/2^k that keeps the shorter side >= target.
   int denom = 1;
   while (denom < 8 &&
-         (int)std::min(cinfo.image_width, cinfo.image_height) / (denom * 2) >=
+         (int)std::min(cinfo->image_width, cinfo->image_height) /
+                 (denom * 2) >=
              resize_side)
     denom *= 2;
-  cinfo.scale_num = 1;
-  cinfo.scale_denom = denom;
-  jpeg_start_decompress(&cinfo);
-  const int w = cinfo.output_width, h = cinfo.output_height;
-  if (w < 1 || h < 1 || cinfo.output_components != 3) {
-    jpeg_abort_decompress(&cinfo);
-    jpeg_destroy_decompress(&cinfo);
-    return cinfo.output_components != 3 ? -2 : -3;
+  cinfo->scale_num = 1;
+  cinfo->scale_denom = denom;
+  jpeg_start_decompress(cinfo);
+  const int w = cinfo->output_width, h = cinfo->output_height;
+  if (w < 1 || h < 1 || cinfo->output_components != 3) {
+    int rc = cinfo->output_components != 3 ? -2 : -3;
+    jpeg_abort_decompress(cinfo);
+    return rc;
   }
-  decoded.resize((size_t)w * h * 3);
-  while ((int)cinfo.output_scanline < h) {
-    uint8_t* row = decoded.data() + (size_t)cinfo.output_scanline * w * 3;
-    jpeg_read_scanlines(&cinfo, &row, 1);
-  }
-  jpeg_finish_decompress(&cinfo);
-  jpeg_destroy_decompress(&cinfo);
 
-  // Aspect-preserving resize: shorter side -> resize_side (round the other,
-  // matching PIL-path semantics in data/imagenet.py::_resize_keep_aspect).
+  // Virtual resized dims (shorter side -> resize_side; round the other,
+  // matching PIL-path semantics in data/imagenet.py::_resize_keep_aspect)
+  // and the crop offsets — known BEFORE decoding, so only the source
+  // window the crop's filter taps touch needs decoding + resizing.
   const float scale = (float)resize_side / std::min(w, h);
   const int rw = std::max(1, (int)std::lround(w * scale));
   const int rh = std::max(1, (int)std::lround(h * scale));
-  std::vector<uint8_t> resized((size_t)rw * rh * 3);
-  resize_bilinear(decoded.data(), w, h, resized.data(), rw, rh);
-
-  if (rw < crop || rh < crop) return -3;
+  if (rw < crop || rh < crop) {
+    jpeg_abort_decompress(cinfo);
+    return -3;
+  }
   const int x0 = fx < 0 ? (rw - crop) / 2
                         : std::min((int)(fx * (rw - crop + 1)), rw - crop);
   const int y0 = fy < 0 ? (rh - crop) / 2
                         : std::min((int)(fy * (rh - crop + 1)), rh - crop);
-  for (int y = 0; y < crop; y++)
-    std::memcpy(out + (size_t)y * crop * 3,
-                resized.data() + ((size_t)(y0 + y) * rw + x0) * 3,
-                (size_t)crop * 3);
+  int col_lo, col_hi, row_lo, row_hi;
+  window_src_cols(w, rw, x0, crop, &col_lo, &col_hi);
+  window_src_rows(h, rh, y0, crop, &row_lo, &row_hi);
+
+  int src_x_off = 0, w_buf = w;
+#ifdef TR_TURBO_CROP
+  // libjpeg-turbo partial decode: only the iMCU-aligned column span the
+  // window needs is dequantized/IDCT'd, and rows outside [row_lo, row_hi)
+  // are skipped (huffman-parsed only).
+  {
+    // Pad the requested span: fancy chroma upsampling reads neighbor
+    // samples, so pixels at the decode boundary can differ from a full
+    // decode — keep the boundary >= 8 px away from any pixel we use.
+    const int pad = 8;
+    int lo = std::max(0, col_lo - pad);
+    JDIMENSION xoff = (JDIMENSION)lo;
+    JDIMENSION xw = (JDIMENSION)(std::min(w, col_hi + pad) - lo);
+    jpeg_crop_scanline(cinfo, &xoff, &xw);
+    src_x_off = (int)xoff;
+    w_buf = (int)cinfo->output_width;
+    row_lo = std::max(0, row_lo - pad);
+    row_hi = std::min(h, row_hi + pad);
+  }
+  while ((int)cinfo->output_scanline < row_lo)
+    jpeg_skip_scanlines(
+        cinfo, (JDIMENSION)(row_lo - (int)cinfo->output_scanline));
+#else
+  row_lo = 0;  // must decode from the top without skip support
+#endif
+  decoded.resize((size_t)(row_hi - row_lo) * w_buf * 3);
+  while ((int)cinfo->output_scanline < row_hi) {
+    uint8_t* row = decoded.data() +
+                   (size_t)((int)cinfo->output_scanline - row_lo) * w_buf * 3;
+    jpeg_read_scanlines(cinfo, &row, 1);
+  }
+  // Abort rather than finish: rows below the window are never decoded and
+  // the (reused) struct returns to the ready state.
+  jpeg_abort_decompress(cinfo);
+
+  resize_bilinear_window(decoded.data(), w, h, w_buf, src_x_off, row_lo, rw,
+                         rh, x0, y0, crop, crop, out);
   return 0;
 #endif  // TR_WITH_JPEG
 }

@@ -72,10 +72,17 @@ def predict_from_export(cfg: RunConfig, export_dir: str, out_dir: str,
     names = load_label_map(cfg, label_file)
     os.makedirs(out_dir, exist_ok=True)
 
+    # A fixed-batch artifact (export --batch-size N) only accepts exactly
+    # N-image calls — chunk the eval split to that size (the split readers
+    # already zero-pad their final batch, labels=-1 marking padding). A
+    # dynamic-batch artifact takes whatever the eval split yields.
+    fixed = bundle.manifest.get("batch_size")
+    fixed = fixed if isinstance(fixed, int) and fixed > 0 else 0
+    chunk = fixed or min(64, num_examples)
+
     all_images, all_labels, all_preds = [], [], []
     seen = 0
-    for images, labels in data_lib.eval_split_batches(
-            cfg.data, min(64, num_examples)):
+    for images, labels in data_lib.eval_split_batches(cfg.data, chunk):
         preds = bundle.predict(images)
         valid = labels >= 0
         all_images.append(images[valid])

@@ -69,6 +69,7 @@ def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int,
     absolute steps (e.g. a profiler trace window) chunks must not straddle."""
     k = max(1, train_cfg.steps_per_call)
     for interval in (train_cfg.log_every, train_cfg.summary_every,
+                     train_cfg.image_summary_every,
                      train_cfg.checkpoint_every, steps_per_epoch):
         if interval > 0:
             k = min(k, interval - step % interval)
@@ -76,6 +77,24 @@ def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int,
         if b > step:
             k = min(k, b - step)
     return min(k, total - step)
+
+
+def _local_image_slice(batch, n: int = 4) -> np.ndarray:
+    """First ``n`` images of a batch as host numpy, multi-host safe: a
+    batch-sharded global array spans non-addressable devices, so slice
+    this process's own shard instead of the global array (device_get of a
+    global slice raises on non-primary-addressable data). Accepts the
+    resident path's host array, a [B,...] device batch, or a staged
+    [stage,B,...] superbatch."""
+    if isinstance(batch, np.ndarray):
+        arr = batch
+    elif getattr(batch, "is_fully_addressable", True):
+        arr = np.asarray(jax.device_get(batch))
+    else:
+        arr = np.asarray(jax.device_get(batch.addressable_shards[0].data))
+    if arr.ndim == 5:  # staged superbatch: first stage row
+        arr = arr[0]
+    return arr[:n]
 
 
 def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
@@ -163,6 +182,11 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     last_summary = step
     m = None  # metrics of the newest dispatched chunk
     stage_buf = None  # current streaming superbatch: (gi, gl, k, offset)
+    # Raw input images for the image-summary channel (reference
+    # cifar_input.py:118): the resident split's head, or the newest
+    # streamed batch; augmented at write time so the summary shows what
+    # the model actually saw.
+    last_inputs = images_np[:4] if resident else None
     while step < total:
         tracer.before(step)
         if resident:
@@ -184,11 +208,13 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             state, m = run_staged(state, gi, gl, off, c)
             step += c
             off += c
+            last_inputs = gi  # reference only; sliced at summary time
             stage_buf = None if off >= k else (gi, gl, k, off)
         else:
             images, labels = next(data_iter)
             state, m = train_step(state, images, labels)
             step += 1
+            last_inputs = images
         tracer.after(step, sync=m)
 
         if step % cfg.train.log_every == 0 or step == total:
@@ -205,6 +231,12 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             if step - last_summary >= cfg.train.summary_every or step == total:
                 metrics.write(step, m)
                 last_summary = step
+        if (cfg.train.image_summary_every > 0 and metrics.enabled
+                and last_inputs is not None
+                and step % cfg.train.image_summary_every == 0):
+            raw = _local_image_slice(last_inputs)
+            aug = augment_fn(jax.random.PRNGKey(step), jnp.asarray(raw))
+            metrics.write_images(step, jax.device_get(aug))
         if step % cfg.train.checkpoint_every == 0 or step == total:
             ckpt.save(step, state)
 

@@ -59,6 +59,40 @@ class MetricsWriter:
                     tf.summary.scalar(k, float(v), step=int(step))
                 self._tb.flush()
 
+    def write_images(self, step: int, images, name: str = "input_images",
+                     max_images: int = 4) -> None:
+        """Input-batch image summary (reference cifar_input.py:118 wrote
+        the augmented training batch via tf.summary.image). ``images`` is
+        a [B,H,W,C] array, float (standardized/mean-subtracted) or uint8;
+        each image is min-max normalized for display. Written to
+        TensorBoard when available, and always as a PNG grid under
+        ``<dir>/images/`` so the channel exists without TF."""
+        if not self.enabled:
+            return
+        import numpy as np
+
+        imgs = np.asarray(images)[:max_images].astype(np.float32)
+        lo = imgs.min(axis=(1, 2, 3), keepdims=True)
+        hi = imgs.max(axis=(1, 2, 3), keepdims=True)
+        imgs = ((imgs - lo) / np.maximum(hi - lo, 1e-6) * 255).astype(
+            np.uint8)
+        if self._tb is not None:
+            import tensorflow as tf  # type: ignore
+            with self._tb.as_default():
+                tf.summary.image(name, imgs, step=int(step),
+                                 max_outputs=max_images)
+                self._tb.flush()
+        try:
+            from PIL import Image
+
+            grid = np.concatenate(list(imgs), axis=1)  # side-by-side strip
+            img_dir = os.path.join(self.directory, "images")
+            os.makedirs(img_dir, exist_ok=True)
+            Image.fromarray(grid).save(
+                os.path.join(img_dir, f"{name}_step{int(step)}.png"))
+        except Exception:  # PIL missing/headless quirks must not kill train
+            pass
+
     def close(self) -> None:
         if self._jsonl is not None:
             self._jsonl.close()
