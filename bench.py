@@ -241,10 +241,12 @@ def _train_step_flops(compiled):
 
 
 def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
-                      batch=128, image=224, dtype="bfloat16"):
+                      batch=128, image=224, dtype="bfloat16",
+                      stem_s2d=None):
     """ImageNet-shaped training step: ResNet-50 @ 224, batch 128, bf16,
     synthetic pre-processed input resident on device. Returns
-    (steps/s, flops_per_step or None)."""
+    (steps/s, flops_per_step or None). ``stem_s2d`` overrides
+    model.stem_space_to_depth (None = config default) for the stem A/B."""
     import jax
     import numpy as np
 
@@ -254,6 +256,10 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
     cfg, model, sched, state, rng = _build_train_setup(
         mesh, "imagenet", resnet_size=resnet_size, batch=batch,
         dtype=dtype, image=image)
+    if stem_s2d is not None and stem_s2d != cfg.model.stem_space_to_depth:
+        from tpu_resnet.models import build_model
+        cfg.model.stem_space_to_depth = stem_s2d
+        model = build_model(cfg)  # same param tree either way
 
     # Pre-processed (VGG mean-subtracted) float input, as the host pipeline
     # would deliver it; one resident batch re-fed each step so the
@@ -285,19 +291,23 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
     return measure_steps / dt, flops
 
 
-def _synthetic_photo_jpeg(size=(640, 480), quality=90):
+def _synthetic_photo_jpeg(size=(640, 480), quality=90, rng=None,
+                          freqs=(8.0, 6.0)):
     """A photo-like test JPEG: smooth structure + mild noise compresses
     ~10:1 like real ImageNet photos. (Uniform noise — the old test image —
     is the pathological worst case: ~1.5:1, entropy-decode-bound, and made
-    every decode-path optimization invisible.)"""
+    every decode-path optimization invisible.) Shared by the host-decode
+    bench and tools/input_edge.py so both measurements rest on the same
+    entropy premise."""
     import io
 
     import numpy as np
     from PIL import Image
 
-    rng = np.random.default_rng(0)
-    xs = np.linspace(0, 8 * np.pi, size[0])
-    ys = np.linspace(0, 6 * np.pi, size[1])
+    if rng is None:
+        rng = np.random.default_rng(0)
+    xs = np.linspace(0, freqs[0] * np.pi, size[0])
+    ys = np.linspace(0, freqs[1] * np.pi, size[1])
     base = (np.sin(xs)[None, :, None] * np.cos(ys)[:, None, None] * 0.5
             + 0.5) * 255
     arr = (base + rng.integers(0, 30, (size[1], size[0], 3))).clip(
@@ -546,6 +556,24 @@ def run_child(kind: str) -> None:
                       file=sys.stderr)
             except Exception as e:
                 errors[f"imagenet_b{b2}"] = f"{type(e).__name__}: {e}"[:500]
+        snapshot()
+        # Stem A/B: the space-to-depth stem (default ON, exact-equivalent
+        # math) vs the plain 7x7/2 form — records what the optimization
+        # buys on this chip at the headline batch.
+        try:
+            sps_plain, _ = _measure_imagenet(mesh, warmup_steps=3,
+                                             measure_steps=15,
+                                             stem_s2d=False)
+            base = result.get("imagenet", {}).get("value")
+            result["imagenet_stem_ab"] = {
+                "plain_stem_steps_per_sec": round(sps_plain, 3),
+                "s2d_stem_steps_per_sec": base,
+                "s2d_speedup": (round(base / sps_plain, 3)
+                                if base else None)}
+            print(f"[bench child] stem A/B: {result['imagenet_stem_ab']}",
+                  file=sys.stderr)
+        except Exception as e:
+            errors["imagenet_stem_ab"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
         # BASELINE.json config 4: Wide-ResNet-28-10 CIFAR-100 b128 — the
         # reference's wide-variant exercise, no published speed line (the

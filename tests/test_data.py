@@ -58,6 +58,40 @@ def test_missing_files_raise(tmp_path):
         cifar.load_cifar("cifar10", str(tmp_path), train=True)
 
 
+def test_synthetic_freq100_task():
+    """The hard convergence task: 100 classes, signal present, label noise
+    train-only and at the requested fraction."""
+    import numpy as np
+
+    imgs, labels = cifar.synthetic_data(256, 32, 100, seed=3,
+                                        learnable=True, task="freq100")
+    assert labels.min() >= 0 and labels.max() <= 99
+    # determinism
+    imgs2, labels2 = cifar.synthetic_data(256, 32, 100, seed=3,
+                                          learnable=True, task="freq100")
+    assert np.array_equal(imgs, imgs2) and np.array_equal(labels, labels2)
+    # the sinusoid signal must be recoverable: the per-row mean of an
+    # image carries its vertical frequency above the noise floor
+    i = 0
+    fy = labels[i] // 10
+    rows = imgs[i].astype(np.float64).mean(axis=(1, 2))
+    spec = np.abs(np.fft.rfft(rows - rows.mean()))
+    assert np.argmax(spec[1:]) + 1 == fy + 1
+
+    # label noise: ~frac of labels resampled, images unchanged
+    _, noisy = cifar.synthetic_data(256, 32, 100, seed=3, learnable=True,
+                                    task="freq100", label_noise=0.25)
+    frac = (noisy != labels).mean()
+    assert 0.1 < frac < 0.3  # 0.25 requested; resamples can collide
+
+
+def test_synthetic_unknown_task_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown synthetic task"):
+        cifar.synthetic_data(8, 32, 10, learnable=True, task="nope")
+
+
 def test_synthetic_deterministic():
     a = cifar.synthetic_data(16, 32, 10, seed=3)
     b = cifar.synthetic_data(16, 32, 10, seed=3)

@@ -121,6 +121,41 @@ def test_cifar_output_shape_and_dtype():
                for x in jax.tree_util.tree_leaves(variables["params"]))
 
 
+def test_s2d_stem_exactly_matches_plain_stem():
+    """The space-to-depth stem must be the SAME function as the 7x7/2
+    stem — same parameter tree (so checkpoints interchange) and equal
+    outputs — not an approximation (models/resnet.py::SpaceToDepthStem)."""
+    import numpy as np
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 64, 64, 3)), jnp.float32)
+    plain = imagenet_resnet_v2(18, 10, dtype=jnp.float32,
+                               stem_space_to_depth=False)
+    s2d = imagenet_resnet_v2(18, 10, dtype=jnp.float32,
+                             stem_space_to_depth=True)
+    v_plain = plain.init(jax.random.PRNGKey(0), x, train=False)
+    v_s2d = s2d.init(jax.random.PRNGKey(0), x, train=False)
+    # identical parameter trees (paths AND values: same init draws)
+    flat_p = jax.tree_util.tree_leaves_with_path(v_plain["params"])
+    flat_s = jax.tree_util.tree_leaves_with_path(v_s2d["params"])
+    assert [p for p, _ in flat_p] == [p for p, _ in flat_s]
+    for (_, a), (_, b) in zip(flat_p, flat_s):
+        np.testing.assert_array_equal(a, b)
+    # same function: apply each model with the OTHER's variables too
+    out_p = plain.apply(v_plain, x, train=False)
+    out_s = s2d.apply(v_plain, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_s),
+                               rtol=1e-5, atol=1e-5)
+
+    # odd spatial size takes the plain-form fallback inside the s2d stem
+    x_odd = jnp.asarray(np.random.default_rng(1).normal(
+        size=(1, 33, 33, 3)), jnp.float32)
+    out_p = plain.apply(v_plain, x_odd, train=False)
+    out_s = s2d.apply(v_plain, x_odd, train=False)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_s),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_imagenet_output_shape():
     model = imagenet_resnet_v2(18, 1000, dtype=jnp.float32)
     variables = model.init(jax.random.PRNGKey(0),

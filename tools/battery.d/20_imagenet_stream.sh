@@ -7,7 +7,7 @@
 # (~510 img/s/core decode vs ~3000 img/s consumed); the honest number +
 # the measured per-core decode rate IS the deliverable (host-count
 # budget: see docs/runs/input_edge_r3.json).
-set -eu
+set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 OUT="${1:-$REPO/docs/runs/watch_r3}"
 SHARDS=/tmp/imagenet_synth_shards

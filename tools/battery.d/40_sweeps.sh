@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Default-tuning sweeps on the live TPU (VERDICT r2 item 7):
+# steps_per_call on the resident path, transfer_stage on the streaming
+# path, and resident-vs-streaming at the tuned points — the measurements
+# behind config.py's data.transfer_stage / train.steps_per_call /
+# data.device_resident defaults.
+set -eu
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RUNS="$REPO/docs/runs"
+cd "$REPO"
+
+timeout 1500 python - <<'EOF'
+import json, sys, time
+sys.path.insert(0, ".")
+import bench
+from tpu_resnet.parallel import create_mesh
+
+mesh = create_mesh(None)
+out = {}
+
+# steps_per_call sweep, resident path (one shared compile cache)
+plans = [(5, 2, 10), (10, 2, 10), (25, 2, 6), (50, 2, 5)]
+by_k = bench._measure_cifar(mesh, plans)
+out["resident_by_steps_per_call"] = {k: round(v, 2)
+                                     for k, v in by_k.items()}
+print("[sweeps] resident by k:", out["resident_by_steps_per_call"],
+      flush=True)
+
+# transfer_stage sweep, streaming path
+stages = {}
+for stage in (4, 8, 16):
+    sps = bench._measure_cifar_streaming(mesh, warmup_super=2,
+                                         measure_super=10, stage=stage)
+    stages[stage] = round(sps, 2)
+    print(f"[sweeps] streaming stage={stage}: {sps:.2f} st/s", flush=True)
+out["streaming_by_transfer_stage"] = stages
+
+best_resident = max(out["resident_by_steps_per_call"].values())
+best_streaming = max(stages.values())
+out["resident_vs_streaming"] = {
+    "resident_best": best_resident, "streaming_best": best_streaming,
+    "resident_wins": best_resident >= best_streaming}
+json.dump(out, open("docs/runs/sweeps_r3.json", "w"), indent=2)
+print("[sweeps]", json.dumps(out))
+EOF

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# MFU analysis on the live TPU (VERDICT r2 item 4): HLO inventory, cost
+# analysis, measured step rate and a profiler trace for the ImageNet
+# train step at b128 and b256; committed artifacts are the JSON summaries
+# and a gzipped compiled-HLO excerpt (the trace stays in the watch dir).
+set -eu
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+OUT="${1:-$REPO/docs/runs/watch_r3}"
+RUNS="$REPO/docs/runs"
+cd "$REPO"
+
+timeout 900 python tools/mfu_probe.py --batch 128 \
+  --out "$RUNS/mfu_b128_r3.json" --hlo-gz "$RUNS/hlo_imagenet_b128_r3.txt.gz" \
+  --trace-dir "$OUT/mfu_trace_b128" | tail -25
+
+timeout 900 python tools/mfu_probe.py --batch 256 \
+  --out "$RUNS/mfu_b256_r3.json" | tail -20

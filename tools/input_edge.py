@@ -20,7 +20,6 @@ the transferable number. A TPU-VM v5e host has 112 vCPU cores.
 """
 
 import argparse
-import io
 import json
 import os
 import sys
@@ -34,10 +33,11 @@ import numpy as np
 
 def make_shards(out_dir: str, n_shards: int = 8, per_shard: int = 96,
                 seed: int = 0, train: bool = True) -> None:
-    """Photo-like JPEGs (mixed sizes around the ImageNet mean ~470x390)
-    wrapped as Inception-style Examples with 1-based labels."""
-    from PIL import Image
-
+    """Photo-like JPEGs (mixed sizes around the ImageNet mean ~470x390,
+    one shared entropy recipe with the host-decode bench:
+    bench._synthetic_photo_jpeg) wrapped as Inception-style Examples with
+    1-based labels."""
+    from bench import _synthetic_photo_jpeg
     from tpu_resnet.data import tfrecord
 
     rng = np.random.default_rng(seed)
@@ -46,17 +46,12 @@ def make_shards(out_dir: str, n_shards: int = 8, per_shard: int = 96,
     for s in range(n_shards):
         records = []
         for i in range(per_shard):
-            w, h = sizes[int(rng.integers(len(sizes)))]
-            xs = np.linspace(0, rng.uniform(2, 12) * np.pi, w)
-            ys = np.linspace(0, rng.uniform(2, 10) * np.pi, h)
-            base = (np.sin(xs)[None, :, None] * np.cos(ys)[:, None, None]
-                    * 0.5 + 0.5) * 255
-            arr = (base + rng.integers(0, 30, (h, w, 3))).clip(
-                0, 255).astype(np.uint8)
-            buf = io.BytesIO()
-            Image.fromarray(arr).save(buf, "JPEG", quality=90)
+            size = sizes[int(rng.integers(len(sizes)))]
+            jpeg = _synthetic_photo_jpeg(
+                size, rng=rng,
+                freqs=(rng.uniform(2, 12), rng.uniform(2, 10)))
             records.append(tfrecord.encode_example({
-                "image/encoded": [buf.getvalue()],
+                "image/encoded": [jpeg],
                 "image/class/label": [int(rng.integers(1, 1001))],
             }))
         tfrecord.write_records(

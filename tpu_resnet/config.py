@@ -34,9 +34,22 @@ class DataConfig:
     # so training must genuinely learn — the no-download stand-in for
     # real-data convergence runs (data/cifar.py::synthetic_data).
     synthetic_learnable: bool = False
+    # synthetic+learnable only: "bands" = easy linear-probe task (smoke
+    # gates); "freq100" = 100-class frequency-pair task with random phase
+    # (augmentation-invariant features required; convergence evidence —
+    # see data/cifar.py::synthetic_data).
+    synthetic_task: str = "bands"
+    # freq100 only: fraction of TRAIN labels resampled uniformly (eval
+    # stays clean). Makes the decayed tail of a piecewise LR schedule
+    # measurably matter.
+    synthetic_label_noise: float = 0.0
     # synthetic only: class count (smoke-test any head size, e.g. the
     # WRN-28-10 CIFAR-100 shape, without the real dataset bytes).
     synthetic_classes: int = 10
+    # synthetic only: split sizes (0 = defaults 1024/256). Convergence
+    # runs on the freq100 task need real split sizes (e.g. 20k/2k).
+    synthetic_train_examples: int = 0
+    synthetic_eval_examples: int = 0
     # Number of worker threads in the host loader (reference uses 16 queue
     # threads, cifar_input.py:99-100; and num_parallel_calls=4 tf.data maps).
     num_workers: int = 4
@@ -85,13 +98,17 @@ class DataConfig:
 
     @property
     def train_examples(self) -> int:
+        if self.dataset == "synthetic":
+            return self.synthetic_train_examples or 1024
         return {"cifar10": 50_000, "cifar100": 50_000,
-                "imagenet": 1_281_167, "synthetic": 1024}[self.dataset]
+                "imagenet": 1_281_167}[self.dataset]
 
     @property
     def eval_examples(self) -> int:
+        if self.dataset == "synthetic":
+            return self.synthetic_eval_examples or 256
         return {"cifar10": 10_000, "cifar100": 10_000,
-                "imagenet": 50_000, "synthetic": 256}[self.dataset]
+                "imagenet": 50_000}[self.dataset]
 
 
 @dataclasses.dataclass
@@ -120,6 +137,10 @@ class ModelConfig:
     # gap (README.md:36) is partly this; both are offered so the delta
     # can be measured.
     sync_bn: bool = True
+    # Execute the ImageNet 7x7/2 stem as a 4x4 conv over space-to-depth
+    # input — identical math and identical parameters/checkpoints, much
+    # better MXU utilization (models/resnet.py::SpaceToDepthStem).
+    stem_space_to_depth: bool = True
     # MLP sanity model (reference logist_model.py:11) hidden units.
     mlp_hidden_units: int = 100
 
@@ -267,7 +288,10 @@ def _parse_value(raw: str, current: Any) -> Any:
     if isinstance(current, float):
         return float(raw)
     if isinstance(current, tuple):
-        return tuple(json.loads(raw))
+        s = raw.strip()
+        if s.startswith("(") and s.endswith(")"):  # accept Python-style
+            s = "[" + s[1:-1].rstrip(",") + "]"    # tuples, not just JSON
+        return tuple(json.loads(s))
     return raw
 
 

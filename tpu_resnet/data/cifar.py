@@ -84,33 +84,79 @@ def load_cifar(dataset: str, data_dir: str, train: bool,
 
 def synthetic_data(num_examples: int, image_size: int = 32,
                    num_classes: int = 10, seed: int = 0,
-                   learnable: bool = False
+                   learnable: bool = False, task: str = "bands",
+                   label_noise: float = 0.0
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic random images for smoke tests and benchmarks (the
     role of the reference's batch_size=10 localhost configs,
     mkl-scripts/run_dist_tf_local.sh:14-21).
 
-    ``learnable=True`` derives labels from image content (brightness of a
-    class-dependent patch) instead of random noise, so a working training
-    loop must drive precision well above chance — the test-scale analog
-    of the reference's convergence-curve verification (SURVEY.md §4.4)."""
-    if learnable and num_classes > image_size:
-        raise ValueError(f"learnable synthetic needs num_classes "
-                         f"({num_classes}) <= image_size ({image_size}) "
-                         f"for distinct bands")
+    ``learnable=True`` derives labels from image content instead of random
+    noise, so a working training loop must drive precision well above
+    chance — the test-scale analog of the reference's convergence-curve
+    verification (SURVEY.md §4.4). Two tasks:
+
+    - ``bands`` (easy): label = which horizontal band is brightened; a
+      linear probe can recover it. Saturates in under an epoch — good for
+      smoke gates, useless for schedule/regularization evidence.
+    - ``freq100`` (hard): label = (vertical, horizontal) spatial-frequency
+      pair of a low-contrast sinusoid with random per-image phase,
+      superposed on noise. Random phase makes position memorization
+      useless; crop shifts phase and flip reverses it without changing
+      frequency, so the features that work are exactly the
+      augmentation-invariant ones. Up to 100 classes. With
+      ``label_noise`` > 0 (train split only) a fraction of labels is
+      resampled — the high-LR phase fits the signal, the decayed tail
+      decides the achievable precision, which is what makes a compressed
+      piecewise schedule visibly matter (VERDICT r2 item 6).
+    """
     rng = np.random.default_rng(seed)
     images = rng.integers(0, 256, (num_examples, image_size, image_size, 3),
                           dtype=np.uint8)
     labels = rng.integers(0, num_classes, (num_examples,), dtype=np.int32)
-    if learnable:
-        # label = which horizontal band is brightened; a linear probe can
-        # recover it, so any functioning model/optimizer learns it fast.
+    if learnable and task == "bands":
+        if num_classes > image_size:
+            raise ValueError(f"bands task needs num_classes "
+                             f"({num_classes}) <= image_size "
+                             f"({image_size}) for distinct bands")
         band = max(1, image_size // num_classes)
         for i, lab in enumerate(labels):
             y0 = int(lab) * band
             sl = images[i, y0:y0 + band]
             images[i, y0:y0 + band] = np.minimum(
                 sl.astype(np.int32) + 120, 255).astype(np.uint8)
+    elif learnable and task == "freq100":
+        if num_classes > 100:
+            raise ValueError(f"freq100 task supports <= 100 classes, "
+                             f"got {num_classes}")
+        # Nyquist guard: the largest frequency used must stay below
+        # image_size/2 cycles or it aliases onto a lower class's signal.
+        max_f = max(((num_classes - 1) // 10) + 1,
+                    min(num_classes, 10))
+        if image_size < 2 * max_f + 1:
+            raise ValueError(
+                f"freq100 with {num_classes} classes uses frequencies up "
+                f"to {max_f} cycles; image_size {image_size} aliases them "
+                f"(needs >= {2 * max_f + 1})")
+        amp = 30.0  # well under the noise std (~74): forces averaging
+        grid = np.arange(image_size, dtype=np.float64)
+        for i, lab in enumerate(labels):
+            fy, fx = divmod(int(lab), 10)
+            py, px = rng.uniform(0, 2 * np.pi, 2)
+            wave = (np.sin(2 * np.pi * (fy + 1) * grid / image_size + py)
+                    [:, None]
+                    + np.sin(2 * np.pi * (fx + 1) * grid / image_size + px)
+                    [None, :])
+            images[i] = np.clip(images[i].astype(np.float64)
+                                + amp * wave[..., None], 0, 255
+                                ).astype(np.uint8)
+        if label_noise > 0:
+            n_noise = int(round(label_noise * num_examples))
+            idx = rng.choice(num_examples, n_noise, replace=False)
+            labels[idx] = rng.integers(0, num_classes, n_noise,
+                                       dtype=np.int32)
+    elif learnable:
+        raise ValueError(f"unknown synthetic task {task!r}")
     return images, labels
 
 
@@ -124,5 +170,8 @@ def load_split(cfg, train: bool) -> Tuple[np.ndarray, np.ndarray]:
         n = cfg.train_examples if train else cfg.eval_examples
         return synthetic_data(n, cfg.resolved_image_size, cfg.num_classes,
                               seed=0 if train else 1,
-                              learnable=cfg.synthetic_learnable)
+                              learnable=cfg.synthetic_learnable,
+                              task=cfg.synthetic_task,
+                              label_noise=(cfg.synthetic_label_noise
+                                           if train else 0.0))
     raise ValueError(f"load_split does not handle {cfg.dataset!r}")

@@ -32,8 +32,9 @@ def build_model(cfg):
     if cfg.model.name != "resnet":
         raise ValueError(f"unknown model {cfg.model.name!r}")
     if cfg.data.dataset == "imagenet":
-        return imagenet_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
-                                  dtype=dtype)
+        return imagenet_resnet_v2(
+            cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
+            stem_space_to_depth=cfg.model.stem_space_to_depth)
     return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
                            width_multiplier=cfg.model.width_multiplier,
                            dtype=dtype)

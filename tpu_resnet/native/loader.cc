@@ -309,28 +309,19 @@ void resize_bilinear_window(const uint8_t* src, int w_full, int h_full,
   }
 }
 
-// Source-pixel span the window's horizontal taps touch (for decode-time
-// column cropping) — recomputes the axis cheaply; decode dominates.
-void window_src_cols(int w_full, int dw, int x0, int cw, int* col_lo,
-                     int* col_hi) {
-  ResampleAxis hx;
-  precompute_axis(w_full, dw, hx);
-  *col_lo = hx.first[x0];
-  int hi = 0;
-  for (int x = x0; x < x0 + cw; x++)
-    hi = std::max(hi, hx.first[x] + hx.count[x]);
-  *col_hi = hi;
-}
-
-void window_src_rows(int h_full, int dh, int y0, int ch, int* row_lo,
-                     int* row_hi) {
-  ResampleAxis vx;
-  precompute_axis(h_full, dh, vx);
-  *row_lo = vx.first[y0];
-  int hi = 0;
-  for (int y = y0; y < y0 + ch; y++)
-    hi = std::max(hi, vx.first[y] + vx.count[y]);
-  *row_hi = hi;
+// Source-pixel span an output window's taps touch along one axis (for
+// decode-time row/column cropping) — recomputes the axis cheaply; decode
+// dominates. Must stay in lockstep with precompute_axis (the same
+// first/count arrays drive resize_bilinear_window's reads).
+void window_src_span(int in_full, int out_full, int o0, int n, int* lo,
+                     int* hi) {
+  ResampleAxis ax;
+  precompute_axis(in_full, out_full, ax);
+  *lo = ax.first[o0];
+  int h = 0;
+  for (int o = o0; o < o0 + n; o++)
+    h = std::max(h, ax.first[o] + ax.count[o]);
+  *hi = h;
 }
 
 }  // namespace
@@ -403,8 +394,8 @@ int32_t tr_decode_jpeg_vgg(const uint8_t* jpeg, int64_t len,
   const int y0 = fy < 0 ? (rh - crop) / 2
                         : std::min((int)(fy * (rh - crop + 1)), rh - crop);
   int col_lo, col_hi, row_lo, row_hi;
-  window_src_cols(w, rw, x0, crop, &col_lo, &col_hi);
-  window_src_rows(h, rh, y0, crop, &row_lo, &row_hi);
+  window_src_span(w, rw, x0, crop, &col_lo, &col_hi);
+  window_src_span(h, rh, y0, crop, &row_lo, &row_hi);
 
   int src_x_off = 0, w_buf = w;
 #ifdef TR_TURBO_CROP
