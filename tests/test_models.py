@@ -156,6 +156,34 @@ def test_s2d_stem_exactly_matches_plain_stem():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_remat_matches_plain(
+):
+    """model.remat must not change the function — same params, same
+    outputs, same gradients (it only changes what backward stores)."""
+    import numpy as np
+
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(2, 32, 32, 3)), jnp.float32)
+    plain = cifar_resnet_v2(8, 10, dtype=jnp.float32)
+    rem = cifar_resnet_v2(8, 10, dtype=jnp.float32, remat=True)
+    v = plain.init(jax.random.PRNGKey(0), x, train=False)
+    v2 = rem.init(jax.random.PRNGKey(0), x, train=False)
+    assert (jax.tree_util.tree_structure(v)
+            == jax.tree_util.tree_structure(v2))
+
+    def loss(model, variables):
+        out, _ = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+        return jnp.sum(out ** 2)
+
+    g1 = jax.grad(lambda p: loss(plain, {**v, "params": p}))(v["params"])
+    g2 = jax.grad(lambda p: loss(rem, {**v, "params": p}))(v["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_imagenet_output_shape():
     model = imagenet_resnet_v2(18, 1000, dtype=jnp.float32)
     variables = model.init(jax.random.PRNGKey(0),

@@ -30,6 +30,9 @@ def main():
     ap.add_argument("--image", type=int, default=224)
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--no-s2d", action="store_true")
+    ap.add_argument("--remat", action="store_true",
+                    help="block rematerialization (for batches past the "
+                         "HBM ceiling, e.g. 512)")
     ap.add_argument("--trace-dir", default="")
     ap.add_argument("--hlo-gz", default="")
     ap.add_argument("--out", default="")
@@ -46,9 +49,10 @@ def main():
     cfg, model, sched, state, rng = bench._build_train_setup(
         mesh, "imagenet", resnet_size=args.resnet_size, batch=args.batch,
         dtype="bfloat16", image=args.image)
-    if args.no_s2d:
+    if args.no_s2d or args.remat:
         from tpu_resnet.models import build_model
-        cfg.model.stem_space_to_depth = False
+        cfg.model.stem_space_to_depth = not args.no_s2d
+        cfg.model.remat = args.remat
         model = build_model(cfg)
 
     bs = parallel.batch_sharding(mesh)
@@ -96,6 +100,7 @@ def main():
     out = {
         "backend": jax.default_backend(), "device_kind": kind,
         "batch": args.batch, "stem_space_to_depth": not args.no_s2d,
+        "remat": args.remat,
         "compile_secs": round(compile_secs, 1),
         "steps_per_sec": round(sps, 3),
         "images_per_sec": round(sps * args.batch, 1),

@@ -141,6 +141,10 @@ class ModelConfig:
     # input — identical math and identical parameters/checkpoints, much
     # better MXU utilization (models/resnet.py::SpaceToDepthStem).
     stem_space_to_depth: bool = True
+    # Rematerialize residual blocks in backward (activation memory
+    # O(depth)): enables batches past the HBM ceiling (e.g. b512 @224)
+    # at ~33% block recompute cost. Off by default.
+    remat: bool = False
     # MLP sanity model (reference logist_model.py:11) hidden units.
     mlp_hidden_units: int = 100
 

@@ -34,7 +34,8 @@ def build_model(cfg):
     if cfg.data.dataset == "imagenet":
         return imagenet_resnet_v2(
             cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
-            stem_space_to_depth=cfg.model.stem_space_to_depth)
+            stem_space_to_depth=cfg.model.stem_space_to_depth,
+            remat=cfg.model.remat)
     return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
                            width_multiplier=cfg.model.width_multiplier,
-                           dtype=dtype)
+                           dtype=dtype, remat=cfg.model.remat)

@@ -172,7 +172,7 @@ class BuildingBlock(nn.Module):
     bn_axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool):
         shortcut = x
         x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
             x, train=train)
@@ -200,7 +200,7 @@ class BottleneckBlock(nn.Module):
     bn_axis_name: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool):
         shortcut = x
         x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
             x, train=train)
@@ -228,15 +228,24 @@ class BlockLayer(nn.Module):
     bottleneck: bool
     dtype: Dtype = jnp.float32
     bn_axis_name: Optional[str] = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         block_cls = BottleneckBlock if self.bottleneck else BuildingBlock
+        if self.remat:
+            # Rematerialize per block: activations are recomputed in the
+            # backward pass instead of stored — trades ~33% more FLOPs in
+            # the block for O(depth) activation memory, buying the larger
+            # batches that raise MXU utilization (pallas_guide: HBM is
+            # the usual ceiling). static_argnums: (self, x, train) — the
+            # bool must stay a Python static.
+            block_cls = nn.remat(block_cls, static_argnums=(2,))
         x = block_cls(self.filters, self.strides, True, self.dtype,
-                      self.bn_axis_name, name="block0")(x, train=train)
+                      self.bn_axis_name, name="block0")(x, train)
         for i in range(1, self.blocks):
             x = block_cls(self.filters, 1, False, self.dtype,
-                          self.bn_axis_name, name=f"block{i}")(x, train=train)
+                          self.bn_axis_name, name=f"block{i}")(x, train)
         return x
 
 
@@ -259,6 +268,11 @@ class ResNetV2(nn.Module):
     # Execute the ImageNet stem as a space-to-depth conv (identical math
     # and identical parameters — see SpaceToDepthStem; safe default).
     stem_space_to_depth: bool = True
+    # Rematerialize residual blocks in the backward pass (activation
+    # memory O(depth) instead of O(depth·width)): enables the larger
+    # batches that raise MXU utilization. Off by default — at b128/b256
+    # the activations fit and remat only adds recompute FLOPs.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -281,8 +295,8 @@ class ResNetV2(nn.Module):
                                           self.stage_blocks,
                                           self.stage_strides)):
             x = BlockLayer(f, b, s, self.bottleneck, self.dtype,
-                           self.bn_axis_name, name=f"block_layer{i + 1}")(
-                x, train=train)
+                           self.bn_axis_name, self.remat,
+                           name=f"block_layer{i + 1}")(x, train=train)
 
         x = BatchNormRelu(self.dtype, self.bn_axis_name, name="final_bnrelu")(
             x, train=train)
@@ -298,7 +312,8 @@ class ResNetV2(nn.Module):
 def cifar_resnet_v2(resnet_size: int, num_classes: int,
                     width_multiplier: int = 1,
                     dtype: Dtype = jnp.bfloat16,
-                    bn_axis_name: Optional[str] = None) -> ResNetV2:
+                    bn_axis_name: Optional[str] = None,
+                    remat: bool = False) -> ResNetV2:
     """6n+2 CIFAR ResNet-v2 (reference resnet_model_official.py:217-278).
 
     'ResNet-50' on CIFAR means n=8 basic blocks per stage with filters
@@ -325,6 +340,7 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
         stem_filters=16,
         dtype=dtype,
         bn_axis_name=bn_axis_name,
+        remat=remat,
     )
 
 
@@ -342,7 +358,8 @@ _IMAGENET_PARAMS = {
 def imagenet_resnet_v2(resnet_size: int, num_classes: int,
                        dtype: Dtype = jnp.bfloat16,
                        bn_axis_name: Optional[str] = None,
-                       stem_space_to_depth: bool = True) -> ResNetV2:
+                       stem_space_to_depth: bool = True,
+                       remat: bool = False) -> ResNetV2:
     """ImageNet ResNet-v2 18/34/50/101/152/200
     (reference resnet_model_official.py:350-366)."""
     if resnet_size not in _IMAGENET_PARAMS:
@@ -360,4 +377,5 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
         dtype=dtype,
         bn_axis_name=bn_axis_name,
         stem_space_to_depth=stem_space_to_depth,
+        remat=remat,
     )
