@@ -1,0 +1,40 @@
+"""tools/input_edge.py — the shard generator + iterator measurement the
+battery's input-edge stages depend on (their first production run happens
+unattended on a live TPU window; this keeps that from being their first
+run ever)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from input_edge import make_shards, measure_iterator  # noqa: E402
+
+
+def test_make_shards_inception_format(tmp_path):
+    """Generated shards must be byte-compatible with the real ImageNet
+    reader path: shard naming, Example keys, 1-based labels, decodable
+    JPEG payloads (reference resnet_imagenet_train.py:105-140)."""
+    from tpu_resnet.data.imagenet import (parse_record, read_shard_records,
+                                          shard_files)
+
+    make_shards(str(tmp_path), n_shards=2, per_shard=3)
+    files = shard_files(str(tmp_path), train=True)
+    assert [os.path.basename(f) for f in files] == [
+        "train-00000-of-00002", "train-00001-of-00002"]
+    recs = list(read_shard_records(files[0], verify_crc=True))
+    assert len(recs) == 3
+    jpeg, label = parse_record(recs[0])
+    assert jpeg[:2] == b"\xff\xd8"  # JPEG SOI
+    assert 1 <= label <= 1000      # 1-based Inception labels
+
+    make_shards(str(tmp_path), n_shards=1, per_shard=2, train=False)
+    assert os.path.exists(tmp_path / "validation-00000-of-00001")
+
+
+def test_measure_iterator_runs(tmp_path):
+    make_shards(str(tmp_path), n_shards=1, per_shard=8)
+    rate = measure_iterator(str(tmp_path), batch=4, workers=1,
+                            use_native=True, n_batches=2)
+    assert rate > 0
