@@ -725,6 +725,29 @@ def main():
     best = None         # (completeness, result, rc, how_died)
     children = probes = 0
 
+    # The driver's own timeout is unknown: if it SIGTERMs the watcher
+    # mid-window, emit the best snapshot so far (or at least the probe
+    # diagnostics) instead of dying with no JSON line at all. The handler
+    # is DISARMED right before any final emit so a late SIGTERM can never
+    # print a second, contradictory JSON line or flip the exit code.
+    import signal
+
+    phase = {"name": "watch window"}
+
+    def _on_term(signum, frame):
+        if best:
+            _emit_tpu(best[1], best[2], best[3] + "; parent SIGTERMed")
+        else:
+            _emit({"backend": "none",
+                   "error": (f"SIGTERM during {phase['name']}; "
+                             + "; ".join(diags))[:2000]}, None)
+        sys.exit(0 if best else 1)
+
+    def _disarm():
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     me = os.path.abspath(__file__)
     while time.time() < deadline and children < max_children:
         ok, diag = _probe_tpu(probe_timeout)
@@ -745,6 +768,7 @@ def main():
         sys.stderr.write(out)
         result = _parse_result(out)
         if result and rc == 0:
+            _disarm()
             _emit_tpu(result, rc, "clean")
             return 0
         how = f"tpu child rc={rc} after {child_timeout}s budget"
@@ -771,14 +795,17 @@ def main():
     if best:
         # Window/attempts exhausted: the most complete partial snapshot
         # still beats a CPU fallback.
+        _disarm()
         _emit_tpu(best[1], best[2], best[3])
         return 0
+    phase["name"] = "cpu fallback"
 
     # Unrecoverable TPU failure: labeled CPU fallback so the round still
     # records a live number plus the TPU diagnostics. An outer watcher
     # (tools/tpu_battery.sh) disables the fallback — it re-polls for a
     # live window itself instead of burning the core on a CPU measurement.
     if os.environ.get("BENCH_CPU_FALLBACK", "1") == "0":
+        _disarm()
         _emit({"backend": "none",
                "error": ("; ".join(diags))[:2000]}, None)
         return 1
@@ -793,11 +820,13 @@ def main():
         result = _salvage(result, rc,
                           f"cpu child rc={rc} after {cpu_timeout}s budget")
         cifar_sps = result.pop("cifar", {}).get("steps_per_sec")
+        _disarm()
         _emit(result, cifar_sps,
               extra={"tpu_error": ("; ".join(diags))[:2000]})
         return 0
     diags.append(f"cpu child: rc={rc}, tail="
                  + " | ".join(out.strip().splitlines()[-3:]))
+    _disarm()
     _emit({"backend": "none", "error": "; ".join(diags)[:2000]}, None)
     return 1
 
