@@ -17,11 +17,11 @@ cd "$REPO"
 
 COMMON="--preset smoke data.synthetic_learnable=true \
   data.synthetic_task=freq100 data.synthetic_classes=100 \
-  data.synthetic_label_noise=0.1 data.synthetic_train_examples=12800 \
-  data.synthetic_eval_examples=2048 model.resnet_size=14 \
-  train.global_batch_size=128 train.train_steps=1200 \
+  data.synthetic_label_noise=0.1 data.synthetic_train_examples=8192 \
+  data.synthetic_eval_examples=2048 model.resnet_size=8 \
+  train.global_batch_size=64 train.train_steps=1200 \
   train.checkpoint_every=500 train.log_every=100 \
-  train.eval_batch_size=128 train.image_summary_every=0 \
+  train.eval_batch_size=64 train.image_summary_every=0 \
   optim.schedule=cifar_piecewise optim.boundaries=(600,900,1100) \
   optim.values=(0.1,0.01,0.001,0.0001)"
 
