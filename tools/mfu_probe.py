@@ -78,6 +78,8 @@ def main():
     interesting = {k: ops.get(k, 0) for k in
                    ("convolution", "fusion", "transpose", "copy",
                     "all-reduce", "custom-call", "reduce", "scatter")}
+    # async collective form some backends emit
+    interesting["all-reduce"] += ops.get("all-reduce-start", 0)
 
     cost = compiled.cost_analysis()
     if isinstance(cost, list):

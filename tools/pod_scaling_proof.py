@@ -1,0 +1,135 @@
+"""Pod-scale compile proof — BASELINE.json config 5 ("ResNet-50 ImageNet,
+128-chip pod scaling, replaces 8ps-128wk").
+
+128 real chips don't exist in this environment (one tunneled v5e), so the
+honest demonstrable artifact is: the FULL ImageNet ResNet-50 training
+step, jitted over a 128-device data-parallel mesh (16 hosts x 8 as the
+reference's 128 workers were 16 nodes x 8), lowers and compiles with the
+expected ICI collectives — on 128 *virtual* CPU devices, the same
+mechanism the driver's dryrun_multichip uses. Where the reference's
+8ps-128wk config collapsed to 0.285 st/s behind one parameter server
+(reference README.md:49, the SyncReplicas scalability wall README.md:7-12),
+the SPMD program has no central party: the gradient all-reduce rides the
+mesh.
+
+    python tools/pod_scaling_proof.py [--devices 128] [--out JSON]
+
+Emits: device count, mesh shape, per-device batch, compile wall time,
+all-reduce op count + reduced bytes from the compiled HLO.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _inner(n_devices: int, per_device_batch: int, image: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_resnet.config import load_config
+    from tpu_resnet import parallel
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import build_schedule, init_state
+    from tpu_resnet.train.step import make_train_step, shard_step
+
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices
+
+    cfg = load_config("imagenet")
+    cfg.data.image_size = image
+    cfg.train.global_batch_size = per_device_batch * n_devices
+    mesh = parallel.create_mesh(cfg.mesh, devices=devices)
+
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                       jnp.zeros((1, image, image, 3)))
+    state = jax.device_put(state, parallel.replicated(mesh))
+
+    bs = parallel.batch_sharding(mesh)
+    images = jax.device_put(
+        np.zeros((cfg.train.global_batch_size, image, image, 3),
+                 np.float32), bs)
+    labels = jax.device_put(
+        np.zeros((cfg.train.global_batch_size,), np.int32), bs)
+
+    step_fn = shard_step(
+        make_train_step(model, cfg.optim, sched, 1000, None,
+                        base_rng=jax.random.PRNGKey(1), mesh=mesh),
+        mesh, donate_state=False)
+    t0 = time.perf_counter()
+    lowered = step_fn.lower(state, images, labels)
+    lower_secs = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    compile_secs = time.perf_counter() - t0
+
+    hlo = compiled.as_text()
+    # Sync and async collective forms (CPU/TPU backends emit either).
+    n_ar = len(re.findall(r"all-reduce(?:-start)?\(", hlo))
+    n_other = {name: len(re.findall(name + r"(?:-start)?\(", hlo))
+               for name in ("all-gather", "reduce-scatter",
+                            "collective-permute")}
+    out = {
+        "devices": n_devices,
+        "mesh": dict(mesh.shape),
+        "per_device_batch": per_device_batch,
+        "global_batch": cfg.train.global_batch_size,
+        "image_size": image,
+        "model": "imagenet_resnet50_v2 bf16",
+        "lower_secs": round(lower_secs, 1),
+        "compile_secs": round(compile_secs, 1),
+        "all_reduce_ops": n_ar,
+        "other_collectives": n_other,
+        "hlo_instructions": hlo.count("\n"),
+    }
+    print("POD_PROOF_JSON: " + json.dumps(out), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=128)
+    ap.add_argument("--per-device-batch", type=int, default=8)
+    ap.add_argument("--image", type=int, default=64,
+                    help="small spatial size keeps the CPU compile fast; "
+                    "sharding/collective structure is size-independent")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--inner", action="store_true")
+    args = ap.parse_args()
+
+    if args.inner:
+        _inner(args.devices, args.per_device_batch, args.image)
+        return 0
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess
+
+    rc, out = run_scrubbed_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--inner",
+         "--devices", str(args.devices),
+         "--per-device-batch", str(args.per_device_batch),
+         "--image", str(args.image)],
+        n_devices=args.devices, timeout=1800)
+    sys.stdout.write(out)
+    if rc != 0:
+        print(f"pod proof failed rc={rc}")
+        return 1
+    for line in reversed(out.splitlines()):
+        if line.startswith("POD_PROOF_JSON: "):
+            result = json.loads(line[len("POD_PROOF_JSON: "):])
+            if args.out:
+                with open(args.out, "w") as f:
+                    json.dump(result, f, indent=2)
+            return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,3 +34,28 @@ def scrubbed_cpu_env(n_devices: int) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
     return env
+
+
+def run_scrubbed_subprocess(argv, n_devices: int, timeout: int):
+    """Run ``argv`` under ``scrubbed_cpu_env(n_devices)`` with merged
+    stdout/stderr and a timeout that yields (124, partial_output) instead
+    of raising — the one subprocess wrapper shared by the driver entry,
+    the doctor's CPU-mesh check, and the pod-scaling proof (they had
+    drifted: only one handled TimeoutExpired). Returns (rc, output)."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(argv, env=scrubbed_cpu_env(n_devices),
+                              cwd=_REPO_ROOT, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout)
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out + f"\n[parent] timeout after {timeout}s"
+    except Exception as e:  # spawn failure (missing interpreter etc.)
+        print(f"[hostenv] subprocess spawn failed: {e}", file=sys.stderr)
+        return 127, f"spawn failed: {e}"

@@ -74,8 +74,7 @@ def _check_backend(timeout: int) -> dict:
 def _check_cpu_mesh(n_devices: int, timeout: int) -> dict:
     """Virtual CPU mesh + one jitted psum-style reduction in a clean
     subprocess (same env scrub as dryrun_multichip)."""
-    from tpu_resnet.hostenv import _REPO_ROOT
-    from tpu_resnet.hostenv import scrubbed_cpu_env as _cpu_env
+    from tpu_resnet.hostenv import run_scrubbed_subprocess
 
     # Test array sized 2*n_devices so any --mesh-devices value divides it
     # evenly (a fixed 16 failed healthy 3/5/6-device meshes).
@@ -89,23 +88,20 @@ def _check_cpu_mesh(n_devices: int, timeout: int) -> dict:
         "NamedSharding(mesh, P('data')))\n"
         "s = jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x)\n"
         "print('MESH_OK', len(devs), float(s))\n")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              env=_cpu_env(n_devices),
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True,
-                              timeout=timeout, cwd=_REPO_ROOT)
-    except subprocess.TimeoutExpired:
+    rc, stdout = run_scrubbed_subprocess([sys.executable, "-c", code],
+                                         n_devices=n_devices,
+                                         timeout=timeout)
+    if rc == 124:
         return {"ok": False, "error": f"CPU mesh smoke hung for {timeout}s"}
     ok = False
     expect = float(n_devices * (2 * n_devices - 1))  # sum(0..2n-1)
-    for line in proc.stdout.splitlines():  # stderr is merged in; scan for
+    for line in stdout.splitlines():       # stderr is merged in; scan for
         if line.startswith("MESH_OK"):     # the marker line specifically
             ok = abs(float(line.split()[-1]) - expect) < 1e-6
             break
     out = {"ok": ok, "devices": n_devices}
     if not ok:
-        out["tail"] = proc.stdout.strip().splitlines()[-3:]
+        out["tail"] = stdout.strip().splitlines()[-3:]
     return out
 
 
