@@ -275,7 +275,10 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
 
     step_fn = shard_step(
         make_train_step(model, cfg.optim, sched, 1000, None,
-                        base_rng=rng, mesh=mesh), mesh, donate_state=False)
+                        base_rng=rng, mesh=mesh), mesh)
+    # donate_state=True (the default, what train/loop.py runs): XLA may
+    # update params in place instead of allocating a fresh state tree —
+    # the measured step is the production configuration.
     compiled = step_fn.lower(state, images, labels).compile()
     flops = _train_step_flops(compiled)
 

@@ -66,7 +66,10 @@ def main():
 
     step_fn = shard_step(
         make_train_step(model, cfg.optim, sched, 1000, None,
-                        base_rng=rng, mesh=mesh), mesh, donate_state=False)
+                        base_rng=rng, mesh=mesh), mesh)
+    # donate_state=True (the default, what train/loop.py runs): XLA may
+    # update params in place instead of allocating a fresh state tree —
+    # the measured step is the production configuration.
     t0 = time.perf_counter()
     compiled = step_fn.lower(state, images, labels).compile()
     compile_secs = time.perf_counter() - t0
