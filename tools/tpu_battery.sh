@@ -13,10 +13,11 @@ cd "$REPO"
 stage_done() { [ -f "$OUT/stage.$1.ok" ]; }
 mark_done() { touch "$OUT/stage.$1.ok"; }
 
-# One core: pause any background CPU convergence runs (tagged conv_bn in
-# their command lines) while TPU measurements are timing-sensitive.
-pkill -STOP -f conv_bn 2>/dev/null || true
-trap 'pkill -CONT -f conv_bn 2>/dev/null || true' EXIT
+# One core: pause any background CPU convergence runs (tagged conv_bn /
+# sched_ in their command lines) while TPU measurements are
+# timing-sensitive.
+pkill -STOP -f 'conv_bn|sched_' 2>/dev/null || true
+trap "pkill -CONT -f 'conv_bn|sched_' 2>/dev/null || true" EXIT
 
 # Re-probe between stages: if the tunnel died mid-battery, return to the
 # watcher's poll loop rather than hanging on the next stage.
