@@ -21,7 +21,7 @@ mkdir -p "$OUT"
 
 echo "[watch] start $(date -u +%FT%TZ) deadline=$(date -u -d @"$DEADLINE" +%FT%TZ) out=$OUT"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if timeout "$PROBE_TIMEOUT" python -c \
+  if timeout -k 10 "$PROBE_TIMEOUT" python -c \
       "import jax; d=jax.devices(); print('LIVE', len(d), d[0].device_kind)" \
       >>"$OUT/probe.log" 2>&1; then
     echo "[watch] TPU LIVE at $(date -u +%FT%TZ) — running battery"

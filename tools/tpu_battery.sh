@@ -22,7 +22,7 @@ trap "pkill -CONT -f 'conv_bn|sched_' 2>/dev/null || true" EXIT
 # Re-probe between stages: if the tunnel died mid-battery, return to the
 # watcher's poll loop rather than hanging on the next stage.
 alive() {
-  timeout 45 python -c "import jax; jax.devices()" >/dev/null 2>&1
+  timeout -k 10 45 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
 # -- stage 1: full bench.py (headline artifact) ---------------------------
