@@ -112,6 +112,22 @@ def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
     return cfg, model, sched, state, rng
 
 
+def _fetch_sync(x) -> float:
+    """Timing barrier that cannot lie: fetch the scalar to the host.
+
+    ``jax.block_until_ready`` was observed returning early on a degrading
+    remote-attached (axon-tunnel) backend — the r3 resident sweep recorded
+    a physically impossible 20,829 st/s (≈ the dispatch-enqueue rate)
+    because readiness resolved before the compute chain actually ran, and
+    r2's streaming 584.3 st/s headline entry is retracted for the same
+    reason (docs/PERF.md). A device→host copy of the result scalar cannot
+    complete before every step it depends on, so every timed loop closes
+    over this instead."""
+    import jax
+    import numpy as np
+    return float(np.asarray(jax.device_get(x)))
+
+
 def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
                    batch=128, dtype="bfloat16", split=50_000, width=None,
                    num_classes=None):
@@ -161,13 +177,13 @@ def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
         for _ in range(warmup_chunks):
             state, metrics = run_chunk(state, step, k)
             step += k
-        jax.block_until_ready(metrics["loss"])
+        _fetch_sync(metrics["loss"])
 
         t0 = time.perf_counter()
         for _ in range(measure_chunks):
             state, metrics = run_chunk(state, step, k)
             step += k
-        jax.block_until_ready(metrics["loss"])
+        _fetch_sync(metrics["loss"])
         results[k] = measure_chunks * k / (time.perf_counter() - t0)
     return results
 
@@ -209,7 +225,7 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
         for _ in range(warmup_super):
             gi, gl, k = next(it)
             state, metrics = run(state, gi, gl, 0, k)
-        jax.block_until_ready(metrics["loss"])
+        _fetch_sync(metrics["loss"])
 
         t0 = time.perf_counter()
         measured = 0
@@ -217,7 +233,7 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
             gi, gl, k = next(it)
             state, metrics = run(state, gi, gl, 0, k)
             measured += k
-        jax.block_until_ready(metrics["loss"])
+        _fetch_sync(metrics["loss"])
         return measured / (time.perf_counter() - t0)
     finally:
         it.close()          # drop the depth-2 staged device buffers
@@ -284,12 +300,12 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
 
     for _ in range(warmup_steps):
         state, metrics = compiled(state, images, labels)
-    jax.block_until_ready(metrics["loss"])
+    _fetch_sync(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(measure_steps):
         state, metrics = compiled(state, images, labels)
-    jax.block_until_ready(metrics["loss"])
+    _fetch_sync(metrics["loss"])
     dt = time.perf_counter() - t0
     return measure_steps / dt, flops
 
@@ -433,9 +449,9 @@ def _measure_pallas_ab(iters=200):
                                       length=iters)
                 return acc
 
-            many(logits).block_until_ready()  # compile + warm
+            _fetch_sync(many(logits))  # compile + warm
             t0 = time.perf_counter()
-            many(logits).block_until_ready()
+            _fetch_sync(many(logits))
             return (time.perf_counter() - t0) / iters * 1e6  # us
 
         pallas_us = time_fn(lambda x: softmax_xent_mean(x, labels))

@@ -98,3 +98,23 @@ def test_shard_map_per_example_over_data_axis():
     g_want = jax.grad(
         lambda x: _reference_per_example(x, labels, c).mean())(logits)
     np.testing.assert_allclose(g_got, g_want, rtol=1e-5, atol=1e-6)
+
+
+def test_make_pallas_xent_mesh_dispatch():
+    """ops.make_pallas_xent: None/1-device meshes return the direct
+    kernel; a multi-device mesh shard_maps the per-example kernel over
+    'data' and matches the reference mean (the train step's opt-in
+    path, tpu_resnet/train/step.py)."""
+    from tpu_resnet.ops import make_pallas_xent, softmax_xent_mean
+    from tpu_resnet.parallel import create_mesh
+
+    assert make_pallas_xent(None) is softmax_xent_mean
+
+    mesh = create_mesh(None, devices=jax.devices()[:8])
+    fn = make_pallas_xent(mesh)
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(16, 10)) * 3, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, 16), jnp.int32)
+    got = jax.jit(fn)(logits, labels)
+    want = _reference_per_example(logits, labels, 10).mean()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

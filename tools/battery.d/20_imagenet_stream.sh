@@ -29,9 +29,12 @@ EOF
 fi
 
 echo "[imagenet_stream] streaming train run (40 steps b128)"
-timeout 1200 python -m tpu_resnet train --preset imagenet \
+# global_batch_size override: the imagenet preset defaults to the pod-scale
+# 1024, which OOMs a single chip's HBM — b128 is the headline config.
+timeout -k 30 1200 python -m tpu_resnet train --preset imagenet \
   data.data_dir="$SHARDS" \
   train.train_dir="$RUN" \
+  train.global_batch_size=128 train.eval_batch_size=128 \
   train.train_steps=40 train.log_every=10 train.checkpoint_every=40 \
   train.image_summary_every=0 2>&1 | tail -20
 

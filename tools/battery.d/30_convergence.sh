@@ -26,7 +26,7 @@ run_arm () {
   name="$1"; shift
   echo "[convergence] arm $name"
   rm -rf "/tmp/conv_$name"
-  timeout 1500 python -m tpu_resnet train_and_eval $COMMON \
+  timeout -k 30 1500 python -m tpu_resnet train_and_eval $COMMON \
     train.train_dir="/tmp/conv_$name" "$@" 2>&1 | tail -5
   mkdir -p "$DEST/$name"
   cp "/tmp/conv_$name/metrics.jsonl" "$DEST/$name/train_metrics.jsonl"

@@ -9,7 +9,7 @@ REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 RUNS="$REPO/docs/runs"
 cd "$REPO"
 
-timeout 1500 python - <<'EOF'
+timeout -k 30 1500 python - <<'EOF'
 import json, sys, time
 sys.path.insert(0, ".")
 import bench

@@ -9,15 +9,15 @@ OUT="${1:-$REPO/docs/runs/watch_r3}"
 RUNS="$REPO/docs/runs"
 cd "$REPO"
 
-timeout 900 python tools/mfu_probe.py --batch 128 \
+timeout -k 30 900 python tools/mfu_probe.py --batch 128 \
   --out "$RUNS/mfu_b128_r3.json" --hlo-gz "$RUNS/hlo_imagenet_b128_r3.txt.gz" \
   --trace-dir "$OUT/mfu_trace_b128" | tail -25
 
-timeout 900 python tools/mfu_probe.py --batch 256 \
+timeout -k 30 900 python tools/mfu_probe.py --batch 256 \
   --out "$RUNS/mfu_b256_r3.json" | tail -20
 
 # b512 needs block remat (activations past the 16 GB HBM ceiling);
 # failure here must not sink the stage — record and move on.
-timeout 900 python tools/mfu_probe.py --batch 512 --remat \
+timeout -k 30 900 python tools/mfu_probe.py --batch 512 --remat \
   --out "$RUNS/mfu_b512_remat_r3.json" | tail -20 \
   || echo "[mfu] b512+remat failed (recorded nothing) — not fatal"

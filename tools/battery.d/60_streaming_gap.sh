@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Resident-vs-streaming step-time isolation on the live chip (r3
+# postmortem of the r2 "streaming 584 st/s" claim): times the same chunk
+# program against (a) a reused device-resident superbatch, (b) the
+# resident epoch buffer, (c) a device-to-device restaged block — all
+# transfer-free in the timed loop, fetch-synced timing.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+timeout -k 30 900 python tools/streaming_gap_probe.py \
+  --out docs/runs/streaming_gap_r3.json | tail -5

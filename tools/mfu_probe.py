@@ -92,11 +92,11 @@ def main():
     # measure
     for _ in range(3):
         state, m = compiled(state, images, labels)
-    jax.block_until_ready(m["loss"])
+    bench._fetch_sync(m["loss"])
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, m = compiled(state, images, labels)
-    jax.block_until_ready(m["loss"])
+    bench._fetch_sync(m["loss"])
     sps = args.steps / (time.perf_counter() - t0)
 
     kind = jax.devices()[0].device_kind
@@ -122,7 +122,7 @@ def main():
         with jax.profiler.trace(args.trace_dir):
             for _ in range(5):
                 state, m = compiled(state, images, labels)
-            jax.block_until_ready(m["loss"])
+            bench._fetch_sync(m["loss"])
         out["trace_dir"] = args.trace_dir
 
     if args.hlo_gz:

@@ -3,7 +3,10 @@
 # live. Stages are checkpointed with marker files so a window that closes
 # mid-battery resumes where it left off on the next live window instead of
 # redoing finished work. Results are archived under docs/runs/.
-set -u
+# pipefail matters: stage results are piped through tee, and without it
+# the `if` below tests tee's status — a failed stage would be marked done
+# (exactly how the r3 stage-20 OOM slipped through on the first window).
+set -u -o pipefail
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT="${1:-$REPO/docs/runs/watch_r3}"
 RUNS="$REPO/docs/runs"

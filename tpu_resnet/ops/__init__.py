@@ -3,9 +3,10 @@ same kernel code runs (slowly) on CPU in tests."""
 
 from tpu_resnet.ops.softmax_xent import (
     is_tpu_backend,
+    make_pallas_xent,
     softmax_xent_mean,
     softmax_xent_per_example,
 )
 
-__all__ = ["is_tpu_backend", "softmax_xent_mean",
+__all__ = ["is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
            "softmax_xent_per_example"]

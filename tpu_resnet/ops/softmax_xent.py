@@ -162,3 +162,34 @@ def softmax_xent_mean(logits: jnp.ndarray, labels: jnp.ndarray,
     train step (tpu_resnet/train/step.py softmax_xent)."""
     return jnp.mean(softmax_xent_per_example(logits, labels,
                                              interpret=interpret))
+
+
+def make_pallas_xent(mesh=None):
+    """Mean-xent callable with the mesh dispatch encapsulated here, so the
+    train step's opt-in costs one trace-time branch.
+
+    Three reachable configurations (VERDICT round-1 item 6): single-device
+    jit and explicit shard_map bodies call the kernel directly (it sees the
+    full/local batch) — pass ``mesh=None``.  Under a multi-device
+    auto-sharded jit, pass the mesh: the per-example kernel is itself
+    shard_mapped over the batch ('data') axis — embarrassingly parallel, no
+    collectives — and the mean taken outside.
+    """
+    if mesh is None or mesh.size <= 1:
+        return softmax_xent_mean
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def mesh_xent(logits, labels, _mesh=mesh):
+        # check_vma off: pallas_call's out_shape carries no vma annotation;
+        # the body is per-example (no collectives), so the output's
+        # data-axis variance is by construction.
+        per_ex = shard_map(
+            softmax_xent_per_example, mesh=_mesh,
+            in_specs=(P("data"), P("data")), out_specs=P("data"),
+            check_vma=False,
+        )(logits, labels)
+        return jnp.mean(per_ex)
+
+    return mesh_xent
