@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CIFAR step cost analysis on the live chip: is the 4.9 ms/step headline
+# (203 st/s, docs/PERF.md) HBM-bandwidth-bound like the ImageNet step,
+# or small-kernel/latency-bound (the 16/32/64-filter convs leave the
+# 128x128 MXU mostly idle)? The measured rate authority stays bench.py's
+# fused chunks — this captures the compiled cost FLOPs/bytes and HLO
+# inventory behind the number.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+timeout -k 30 900 python tools/mfu_probe.py --preset cifar10 --batch 128 \
+  --out docs/runs/cifar_cost_r3.json \
+  --hlo-gz docs/runs/hlo_cifar_b128_r3.txt.gz | tail -20

@@ -25,6 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="imagenet",
+                    choices=["imagenet", "cifar10"],
+                    help="cifar10 analyzes the CIFAR-shaped step (32x32, "
+                         "synthetic split, on-device augmentation included "
+                         "like the real train step); note its single-step "
+                         "dispatch rate is latency-skewed over a tunnel — "
+                         "bench.py's fused chunks are the rate authority, "
+                         "the cost analysis is what this adds")
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--resnet-size", type=int, default=50)
     ap.add_argument("--image", type=int, default=224)
@@ -45,10 +53,21 @@ def main():
     from tpu_resnet import parallel
     from tpu_resnet.train.step import make_train_step, shard_step
 
+    is_cifar = args.preset == "cifar10"
+    if is_cifar and (args.no_s2d or args.image != 224):
+        # The CIFAR generator has a 3x3/1 stem (no s2d to ablate) and a
+        # fixed 32x32 shape — fail loudly rather than record metadata for
+        # a configuration that was never compiled (bench.py's
+        # conflicting-override convention).
+        raise SystemExit("--no-s2d/--image do not apply to --preset "
+                         "cifar10 (3x3 stem, fixed 32x32)")
+    image = 32 if is_cifar else args.image
+    classes = 10 if is_cifar else 1000
+
     mesh = parallel.create_mesh(None)
     cfg, model, sched, state, rng = bench._build_train_setup(
-        mesh, "imagenet", resnet_size=args.resnet_size, batch=args.batch,
-        dtype="bfloat16", image=args.image)
+        mesh, args.preset, resnet_size=args.resnet_size, batch=args.batch,
+        dtype="bfloat16", image=image, synthetic=is_cifar)
     if args.no_s2d or args.remat:
         from tpu_resnet.models import build_model
         cfg.model.stem_space_to_depth = not args.no_s2d
@@ -56,16 +75,24 @@ def main():
         model = build_model(cfg)
 
     bs = parallel.batch_sharding(mesh)
-    images = jax.device_put(
-        np.random.RandomState(0).uniform(
-            -114.0, 141.0,
-            (args.batch, args.image, args.image, 3)).astype(np.float32), bs)
+    if is_cifar:
+        from tpu_resnet.data.augment import get_augment_fns
+        augment_fn, _ = get_augment_fns("cifar10")
+        images = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, 256, (args.batch, image, image, 3)).astype(np.uint8), bs)
+    else:
+        augment_fn = None
+        images = jax.device_put(
+            np.random.RandomState(0).uniform(
+                -114.0, 141.0,
+                (args.batch, image, image, 3)).astype(np.float32), bs)
     labels = jax.device_put(
-        np.random.RandomState(1).randint(0, 1000, args.batch)
+        np.random.RandomState(1).randint(0, classes, args.batch)
         .astype(np.int32), bs)
 
     step_fn = shard_step(
-        make_train_step(model, cfg.optim, sched, 1000, None,
+        make_train_step(model, cfg.optim, sched, classes, augment_fn,
                         base_rng=rng, mesh=mesh), mesh)
     # donate_state=True (the default, what train/loop.py runs): XLA may
     # update params in place instead of allocating a fresh state tree —
@@ -104,7 +131,10 @@ def main():
     flops = float(cost.get("flops", 0) or 0)
     out = {
         "backend": jax.default_backend(), "device_kind": kind,
-        "batch": args.batch, "stem_space_to_depth": not args.no_s2d,
+        "preset": args.preset, "image": image,
+        "batch": args.batch,
+        # s2d only exists on the ImageNet 7x7/2 stem; None = not applicable
+        "stem_space_to_depth": None if is_cifar else not args.no_s2d,
         "remat": args.remat,
         "compile_secs": round(compile_secs, 1),
         "steps_per_sec": round(sps, 3),
