@@ -1,0 +1,34 @@
+"""tools/streaming_gap_probe.py — the resident-vs-staged input-placement
+probe behind battery stage 60 (its first production run happens unattended
+on a live TPU window; this keeps that from being its first run ever)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import streaming_gap_probe  # noqa: E402
+
+
+def test_probe_tiny_config(tmp_path, monkeypatch):
+    out = tmp_path / "gap.json"
+    monkeypatch.setattr(sys, "argv", [
+        "streaming_gap_probe.py", "--resnet-size", "8", "--batch", "16",
+        "--split", "256", "--stage", "2", "--reps", "2", "--warmup", "1",
+        "--out", str(out)])
+    streaming_gap_probe.main()
+    got = json.load(open(out))
+    for key in ("staged_steps_per_sec", "resident_steps_per_sec",
+                "restage_steps_per_sec"):
+        assert got[key] > 0, got
+
+
+def test_probe_rejects_zero_warmup(monkeypatch):
+    monkeypatch.setattr(sys, "argv", [
+        "streaming_gap_probe.py", "--warmup", "0"])
+    with pytest.raises(SystemExit):
+        streaming_gap_probe.main()

@@ -42,6 +42,11 @@ def main():
     ap.add_argument("--stage", type=int, default=8)
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
+    # Tiny-config overrides so the CPU-mesh test can smoke the exact code
+    # the live window runs unattended (tests/test_streaming_gap_probe.py).
+    ap.add_argument("--resnet-size", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--split", type=int, default=50_000)
     args = ap.parse_args()
 
     import jax
@@ -61,11 +66,18 @@ def main():
     if warm < 1 or reps < 1:
         raise SystemExit("--warmup and --reps must be >= 1 (the timed "
                          "loop syncs on the warmed metrics)")
+    if args.split // args.batch < stage:
+        raise SystemExit(
+            f"--split/--batch = {args.split // args.batch} steps per epoch "
+            f"< --stage {stage}: the stage-sized slices would clamp and "
+            "silently time overlapping data")
     out = {"device": jax.devices()[0].device_kind, "stage": stage,
-           "reps": reps}
+           "reps": reps, "resnet_size": args.resnet_size,
+           "batch": args.batch, "split": args.split}
 
     cfg, model, sched, state0, rng = bench._build_train_setup(
-        mesh, "cifar10", resnet_size=50, batch=128, dtype="bfloat16",
+        mesh, "cifar10", resnet_size=args.resnet_size, batch=args.batch,
+        dtype="bfloat16",
         image=32, synthetic=True)
     batch = cfg.train.global_batch_size
     augment_fn, _ = get_augment_fns("cifar10")
@@ -101,9 +113,10 @@ def main():
 
     # (b) resident epoch buffer (fresh state — donation consumed state0).
     _, _, _, state1, _ = bench._build_train_setup(
-        mesh, "cifar10", resnet_size=50, batch=128, dtype="bfloat16",
+        mesh, "cifar10", resnet_size=args.resnet_size, batch=args.batch,
+        dtype="bfloat16",
         image=32, synthetic=True)
-    images, labels = cifar_data.synthetic_data(50_000, 32, 10)
+    images, labels = cifar_data.synthetic_data(args.split, 32, 10)
     ds = device_data.DeviceDataset(mesh, images, labels, batch, seed=0)
     run_res = device_data.compile_resident_steps(base_step, ds, mesh, stage)
     counter = {"step": 0}
@@ -122,7 +135,8 @@ def main():
     # (c) restage: device-to-device copy of the chunk block into a small
     # staging buffer, then the same staged program consumes it.
     _, _, _, state2, _ = bench._build_train_setup(
-        mesh, "cifar10", resnet_size=50, batch=128, dtype="bfloat16",
+        mesh, "cifar10", resnet_size=args.resnet_size, batch=args.batch,
+        dtype="bfloat16",
         image=32, synthetic=True)
 
     @jax.jit
