@@ -1,0 +1,70 @@
+"""ops/fused_block.py — interpret-mode correctness of the experimental
+fused v2 basic-block forward vs the XLA reference (its first TPU run
+happens unattended in battery stage 80; this keeps that from being its
+first run ever)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resnet.ops.fused_block import block_fwd, block_fwd_reference
+
+
+def _params(c, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda *s: jnp.asarray(rng.normal(size=s) * 0.1, dtype)
+    return (mk(3, 3, c, c), mk(3, 3, c, c),
+            jnp.asarray(rng.uniform(0.5, 1.5, c), dtype),
+            mk(c), jnp.asarray(rng.uniform(0.5, 1.5, c), dtype), mk(c))
+
+
+@pytest.mark.parametrize("b,hw,c,bt", [(4, 8, 16, 2), (2, 8, 32, 2),
+                                       (8, 4, 16, 8)])
+def test_fused_block_matches_reference(b, hw, c, bt):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(b, hw, hw, c)), jnp.float32)
+    params = _params(c)
+    got = block_fwd(x, *params, batch_tile=bt, interpret=True)
+    want = block_fwd_reference(x, *params)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_block_bf16_io():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 16)), jnp.bfloat16)
+    params = _params(16, dtype=jnp.bfloat16)
+    got = block_fwd(x, *params, batch_tile=2, interpret=True)
+    want = block_fwd_reference(x, *params)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(got.astype(np.float32),
+                               want.astype(np.float32), rtol=5e-2,
+                               atol=5e-2)
+
+
+def test_fused_block_rejects_ragged_tile():
+    x = jnp.zeros((6, 4, 4, 16))
+    with pytest.raises(ValueError, match="not divisible"):
+        block_fwd(x, *_params(16), batch_tile=4, interpret=True)
+
+
+def test_ab_harness_tiny(tmp_path, monkeypatch):
+    """The battery-stage-80 harness runs unattended on a live window;
+    drive its exact code path at tiny config first (same pattern as
+    tests/test_streaming_gap_probe.py)."""
+    import json
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import fused_block_ab
+
+    out = tmp_path / "ab.json"
+    monkeypatch.setattr(fused_block_ab, "SHAPES", [(8, 8, 8, 16, 4)])
+    monkeypatch.setattr(sys, "argv", [
+        "fused_block_ab.py", "--length", "2", "--reps", "1",
+        "--dtype", "float32", "--out", str(out)])
+    fused_block_ab.main()
+    got = json.load(open(out))["by_shape"]["b8_8x8x16"]
+    assert got["pallas_us_per_block"] > 0 and got["xla_us_per_block"] > 0

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Fused-block hypothesis test on the live chip (docs/PERF.md "CIFAR is
+# overhead-bound"): one Pallas kernel per v2 basic block vs XLA's several
+# fused loops for the identical math, at the CIFAR ResNet's three stage
+# shapes. Decides whether the round-4 training-path fused block (batch
+# stats + custom VJP) is worth building.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+timeout -k 30 900 python tools/fused_block_ab.py \
+  --out docs/runs/fused_block_ab_r3.json | tail -6

@@ -1,0 +1,97 @@
+"""A/B the fused Pallas basic-block forward against XLA's compilation of
+the identical math, at the CIFAR ResNet's three stage shapes (the
+decisive experiment for docs/PERF.md's "CIFAR is overhead-bound"
+hypothesis — see ops/fused_block.py).
+
+Each arm chains L sequential block applications inside ONE lax.scan
+dispatch (per-dispatch tunnel latency cannot mask per-block costs), with
+chained inputs so XLA can neither hoist nor overlap iterations. Timing
+is fetch-synced (bench._fetch_sync).
+
+    python tools/fused_block_ab.py [--out JSON] [--length 32] [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (batch, spatial, channels, batch_tile): the three CIFAR-ResNet stage
+# shapes (models/resnet.py cifar_resnet_v2 — 16@32x32, 32@16x16, 64@8x8).
+SHAPES = [(128, 32, 32, 16, 16), (128, 16, 16, 32, 32),
+          (128, 8, 8, 64, 128)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--length", type=int, default=32,
+                    help="blocks chained per dispatch")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    args = ap.parse_args()
+    if args.length < 1 or args.reps < 1:
+        raise SystemExit("--length and --reps must be >= 1")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from tpu_resnet.ops.fused_block import block_fwd, block_fwd_reference
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    out = {"device": jax.devices()[0].device_kind, "length": args.length,
+           "dtype": args.dtype, "by_shape": {}}
+
+    for b, h, w, c, bt in SHAPES:
+        rng = np.random.default_rng(c)
+        x0 = jnp.asarray(rng.normal(size=(b, h, w, c)), dtype)
+        # Tiny weights: 32 chained residual blocks must stay finite.
+        params = (
+            jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.01, dtype),
+            jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.01, dtype),
+            jnp.ones((c,), dtype), jnp.zeros((c,), dtype),
+            jnp.ones((c,), dtype), jnp.zeros((c,), dtype))
+
+        def chained(block):
+            @jax.jit
+            def run(x):
+                def body(xc, _):
+                    return block(xc, *params), None
+                xc, _ = jax.lax.scan(body, x, None, length=args.length)
+                return jnp.float32(jnp.sum(xc))
+            return run
+
+        def time_arm(run):
+            bench._fetch_sync(run(x0))  # compile + warm
+            best = float("inf")
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                bench._fetch_sync(run(x0))
+                best = min(best, time.perf_counter() - t0)
+            return best / args.length * 1e6  # us per block
+
+        pallas_us = time_arm(chained(
+            lambda x, *p: block_fwd(x, *p, batch_tile=bt)))
+        xla_us = time_arm(chained(block_fwd_reference))
+        key = f"b{b}_{h}x{w}x{c}"
+        out["by_shape"][key] = {
+            "pallas_us_per_block": round(pallas_us, 2),
+            "xla_us_per_block": round(xla_us, 2),
+            "speedup": round(xla_us / pallas_us, 3)}
+        print(key, out["by_shape"][key], flush=True)
+
+    print(json.dumps(out))
+    if args.out:
+        json.dump(out, open(args.out, "w"), indent=2)
+
+
+if __name__ == "__main__":
+    main()

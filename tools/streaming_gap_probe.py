@@ -66,6 +66,8 @@ def main():
     if warm < 1 or reps < 1:
         raise SystemExit("--warmup and --reps must be >= 1 (the timed "
                          "loop syncs on the warmed metrics)")
+    if args.batch < 1 or args.split < 1 or stage < 1:
+        raise SystemExit("--batch/--split/--stage must be >= 1")
     if args.split // args.batch < stage:
         raise SystemExit(
             f"--split/--batch = {args.split // args.batch} steps per epoch "

@@ -1,0 +1,123 @@
+"""EXPERIMENTAL: ResNet-v2 basic-block forward as ONE Pallas TPU kernel.
+
+Motivation (docs/PERF.md "CIFAR step is overhead-bound"): the CIFAR
+ResNet's 16/32/64-channel convolutions run ~3.7× above even the HBM
+bandwidth roofline — per-fused-op fixed costs dominate when ops are this
+small. XLA executes a v2 basic block as several sequential fused loops
+(BN, conv, BN, conv, add), each paying pipeline fill/drain; this kernel
+executes the whole block — scale-bias, ReLU, two 3×3 convs (as 9-tap
+shifted matmuls), residual add — in a single VMEM-resident program, one
+HBM round trip per block.
+
+Scope: FORWARD ONLY, stride 1, equal in/out channels, BN folded to
+scale/bias (stats supplied — the cross-batch stats reduction is an
+orthogonal pass either way). This is the decisive primitive for the
+"fewer, bigger kernels" hypothesis: battery stage 80 A/Bs it against
+XLA's compilation of the identical math (`block_fwd_reference`) at CIFAR
+shapes on a live window. If it wins, the training-path version (batch
+stats + custom VJP + strided/projection variants) is round-4 work; if it
+loses, the negative result is recorded next to the xent kernel's
+(docs/PERF.md) and this file stays an exemplar.
+
+Reference block semantics: v2 preactivation residual block,
+reference resnet_model_official.py:144-186 (building_block_v2).
+"""
+
+from __future__ import annotations
+
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; absent on pure-CPU installs of older jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from tpu_resnet.ops.softmax_xent import is_tpu_backend
+
+
+def _scale_bias_relu(x, scale, bias):
+    return jnp.maximum(x * scale + bias, 0.0)
+
+
+def _conv3x3_taps(h_pad, w, bt, h, wdt, c):
+    """3×3 SAME conv over the padded [Bt, H+2, W+2, C] input as 9 shifted
+    (Bt·H·W, C) @ (C, C) matmuls accumulating in fp32 — each tap is an MXU
+    dot over the flattened pixel rows."""
+    acc = jnp.zeros((bt * h * wdt, c), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            patch = h_pad[:, dy:dy + h, dx:dx + wdt, :].reshape(
+                bt * h * wdt, c)
+            acc = acc + jnp.dot(patch, w[dy, dx],
+                                preferred_element_type=jnp.float32)
+    return acc.reshape(bt, h, wdt, c)
+
+
+def _block_kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref,
+                  o_ref):
+    bt, h, wdt, c = x_ref.shape
+    x = x_ref[...].astype(jnp.float32)
+    pre1 = _scale_bias_relu(x, s1_ref[...], b1_ref[...])
+    pre1 = jnp.pad(pre1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    mid = _conv3x3_taps(pre1, w1_ref[...].astype(jnp.float32),
+                        bt, h, wdt, c)
+    pre2 = _scale_bias_relu(mid, s2_ref[...], b2_ref[...])
+    pre2 = jnp.pad(pre2, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    out = _conv3x3_taps(pre2, w2_ref[...].astype(jnp.float32),
+                        bt, h, wdt, c)
+    o_ref[...] = (x + out).astype(o_ref.dtype)
+
+
+def block_fwd(x, w1, w2, s1, b1, s2, b2, *, batch_tile: int = 16,
+              interpret: bool | None = None):
+    """Fused v2 basic-block forward.
+
+    x [B,H,W,C]; w1,w2 [3,3,C,C]; s1,b1,s2,b2 [C] (folded BN).
+    Returns x + conv2(relu(sb2(conv1(relu(sb1(x)))))), same dtype as x.
+    """
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    b, h, wdt, c = x.shape
+    bt = min(batch_tile, b)
+    if b % bt:
+        raise ValueError(f"batch {b} not divisible by batch_tile {bt}")
+
+    grid = (b // bt,)
+    full = lambda *shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    kwargs = {}
+    if _VMEM is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return pl.pallas_call(
+        _block_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, h, wdt, c), lambda i: (i, 0, 0, 0)),
+            full(3, 3, c, c), full(3, 3, c, c),
+            full(c), full(c), full(c), full(c),
+        ],
+        out_specs=pl.BlockSpec((bt, h, wdt, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+        **kwargs,
+    )(x, w1, w2, s1, b1, s2, b2)
+
+
+@jax.jit
+def block_fwd_reference(x, w1, w2, s1, b1, s2, b2):
+    """The identical math as XLA compiles it (the A/B's other arm and the
+    correctness oracle for tests)."""
+    xf = x.astype(jnp.float32)
+    dn = ("NHWC", "HWIO", "NHWC")
+    pre1 = _scale_bias_relu(xf, s1, b1)
+    mid = jax.lax.conv_general_dilated(
+        pre1, w1.astype(jnp.float32), (1, 1), "SAME", dimension_numbers=dn)
+    pre2 = _scale_bias_relu(mid, s2, b2)
+    out = jax.lax.conv_general_dilated(
+        pre2, w2.astype(jnp.float32), (1, 1), "SAME", dimension_numbers=dn)
+    return (xf + out).astype(x.dtype)
