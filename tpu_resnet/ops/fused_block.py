@@ -9,21 +9,25 @@ executes the whole block — scale-bias, ReLU, two 3×3 convs (as 9-tap
 shifted matmuls), residual add — in a single VMEM-resident program, one
 HBM round trip per block.
 
-Scope: FORWARD ONLY, stride 1, equal in/out channels, BN folded to
-scale/bias (stats supplied — the cross-batch stats reduction is an
-orthogonal pass either way). This is the decisive primitive for the
-"fewer, bigger kernels" hypothesis: battery stage 80 A/Bs it against
-XLA's compilation of the identical math (`block_fwd_reference`) at CIFAR
-shapes on a live window. If it wins, the training-path version (batch
-stats + custom VJP + strided/projection variants) is round-4 work; if it
-loses, the negative result is recorded next to the xent kernel's
-(docs/PERF.md) and this file stays an exemplar.
+Scope: stride 1, equal in/out channels (22 of the CIFAR ResNet-50's 24
+blocks), BN folded to scale/bias (stats supplied — the cross-batch stats
+reduction is an orthogonal pass either way). ``block_apply`` is the full
+differentiable primitive: Pallas forward + Pallas backward via
+``jax.custom_vjp``, with the backward kernel recomputing the forward
+chain in VMEM from ``x`` alone — no residual tensors ever touch HBM.
+Battery stage 80 A/Bs both directions against XLA's compilation of the
+identical math (`block_fwd_reference`) at CIFAR shapes on a live window.
+A win green-lights model integration (batch stats + strided/projection
+variants); a loss gets recorded next to the xent kernel's negative
+result (docs/PERF.md) and this file stays an exemplar.
 
 Reference block semantics: v2 preactivation residual block,
 reference resnet_model_official.py:144-186 (building_block_v2).
 """
 
 from __future__ import annotations
+
+import functools
 
 
 import jax
@@ -73,6 +77,26 @@ def _block_kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref,
     o_ref[...] = (x + out).astype(o_ref.dtype)
 
 
+def _plumbing(x, batch_tile, interpret):
+    """Shared pallas_call scaffolding for the fwd and bwd kernels:
+    (resolved interpret, batch tile, grid, tile BlockSpec, whole-array
+    BlockSpec factory, compiler kwargs)."""
+    if interpret is None:
+        interpret = not is_tpu_backend()
+    b, h, wdt, c = x.shape
+    bt = min(batch_tile, b)
+    if b % bt:
+        raise ValueError(f"batch {b} not divisible by batch_tile {bt}")
+    grid = (b // bt,)
+    tile = pl.BlockSpec((bt, h, wdt, c), lambda i: (i, 0, 0, 0))
+    full = lambda *shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
+    kwargs = {}
+    if _VMEM is not None and not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",))
+    return interpret, bt, grid, tile, full, kwargs
+
+
 def block_fwd(x, w1, w2, s1, b1, s2, b2, *, batch_tile: int = 16,
               interpret: bool | None = None):
     """Fused v2 basic-block forward.
@@ -80,28 +104,15 @@ def block_fwd(x, w1, w2, s1, b1, s2, b2, *, batch_tile: int = 16,
     x [B,H,W,C]; w1,w2 [3,3,C,C]; s1,b1,s2,b2 [C] (folded BN).
     Returns x + conv2(relu(sb2(conv1(relu(sb1(x)))))), same dtype as x.
     """
-    if interpret is None:
-        interpret = not is_tpu_backend()
-    b, h, wdt, c = x.shape
-    bt = min(batch_tile, b)
-    if b % bt:
-        raise ValueError(f"batch {b} not divisible by batch_tile {bt}")
-
-    grid = (b // bt,)
-    full = lambda *shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape))
-    kwargs = {}
-    if _VMEM is not None and not interpret:
-        kwargs["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",))
+    interpret, bt, grid, tile, full, kwargs = _plumbing(
+        x, batch_tile, interpret)
+    c = x.shape[-1]
     return pl.pallas_call(
         _block_kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((bt, h, wdt, c), lambda i: (i, 0, 0, 0)),
-            full(3, 3, c, c), full(3, 3, c, c),
-            full(c), full(c), full(c), full(c),
-        ],
-        out_specs=pl.BlockSpec((bt, h, wdt, c), lambda i: (i, 0, 0, 0)),
+        in_specs=[tile, full(3, 3, c, c), full(3, 3, c, c),
+                  full(c), full(c), full(c), full(c)],
+        out_specs=tile,
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
         interpret=interpret,
         **kwargs,
@@ -121,3 +132,167 @@ def block_fwd_reference(x, w1, w2, s1, b1, s2, b2):
     out = jax.lax.conv_general_dilated(
         pre2, w2.astype(jnp.float32), (1, 1), "SAME", dimension_numbers=dn)
     return (xf + out).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# Backward: one Pallas kernel, activations recomputed in VMEM from x alone
+# --------------------------------------------------------------------------
+#
+# Forward chain (per tile, all VMEM):
+#   a1 = s1·x + b1 ; r1 = relu(a1) ; c1 = conv(r1, w1)
+#   a2 = s2·c1 + b2 ; r2 = relu(a2) ; c2 = conv(r2, w2) ; y = x + c2
+# Backward, given gy (= dL/dy):
+#   dr2 = convT(gy, w2)            da2 = dr2 ⊙ [a2>0]
+#   dc1 = s2·da2                   ds2 = Σ da2⊙c1 ;  db2 = Σ da2
+#   dw2[t] = r2_patch(t)ᵀ @ gy     (9 taps)
+#   dr1 = convT(dc1, w1)           da1 = dr1 ⊙ [a1>0]
+#   dx  = gy + s1·da1              ds1 = Σ da1⊙x ;  db1 = Σ da1
+#   dw1[t] = r1_patch(t)ᵀ @ dc1
+# convT (transposed SAME 3×3) = taps with spatially-flipped, C-transposed
+# weights. Nothing but x, gy and the params is read from HBM; no residual
+# tensors are ever materialized there — the bandwidth-minimal design the
+# CIFAR analysis calls for. Weight/scale/bias grads accumulate across the
+# sequential batch-tile grid into their output refs.
+
+
+def _transpose_weights(w):
+    """Weights of the transposed SAME 3×3 conv: spatial flip + IO-channel
+    swap, so convT(d, w) == _conv3x3_taps(d_pad, _transpose_weights(w))."""
+    return w[::-1, ::-1].transpose(0, 1, 3, 2)
+
+
+def _wgrad_taps(r_pad, d, bt, h, wdt, c):
+    """dw[dy,dx] = r_patch(dy,dx)ᵀ @ d — nine (C, M)@(M, C) matmuls."""
+    dm = d.reshape(bt * h * wdt, c)
+    rows = []
+    for dy in range(3):
+        row = []
+        for dx in range(3):
+            patch = r_pad[:, dy:dy + h, dx:dx + wdt, :].reshape(
+                bt * h * wdt, c)
+            row.append(jnp.dot(patch.T, dm,
+                               preferred_element_type=jnp.float32))
+        rows.append(jnp.stack(row))
+    return jnp.stack(rows)  # [3,3,C,C]
+
+
+def _block_bwd_kernel(x_ref, gy_ref, w1_ref, w2_ref, s1_ref, b1_ref,
+                      s2_ref, b2_ref,
+                      dx_ref, dw1_ref, dw2_ref, ds1_ref, db1_ref,
+                      ds2_ref, db2_ref):
+    bt, h, wdt, c = x_ref.shape
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    gy = gy_ref[...].astype(jnp.float32)
+    w1 = w1_ref[...].astype(jnp.float32)
+    w2 = w2_ref[...].astype(jnp.float32)
+    s1, b1 = s1_ref[...], b1_ref[...]
+    s2, b2 = s2_ref[...], b2_ref[...]
+
+    # Recompute the forward chain in VMEM.
+    a1 = x * s1 + b1
+    r1 = jnp.maximum(a1, 0.0)
+    r1p = jnp.pad(r1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    c1 = _conv3x3_taps(r1p, w1, bt, h, wdt, c)
+    a2 = c1 * s2 + b2
+    r2 = jnp.maximum(a2, 0.0)
+    r2p = jnp.pad(r2, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+    # Backward chain (convT = taps over the flipped/IO-swapped weights).
+    gyp = jnp.pad(gy, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    dr2 = _conv3x3_taps(gyp, _transpose_weights(w2), bt, h, wdt, c)
+    da2 = jnp.where(a2 > 0, dr2, 0.0)
+    dc1 = da2 * s2
+    dc1p = jnp.pad(dc1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    dr1 = _conv3x3_taps(dc1p, _transpose_weights(w1), bt, h, wdt, c)
+    da1 = jnp.where(a1 > 0, dr1, 0.0)
+    dx_ref[...] = (gy + da1 * s1).astype(dx_ref.dtype)
+
+    # Parameter grads: accumulate across the sequential batch-tile grid.
+    dw1 = _wgrad_taps(r1p, dc1, bt, h, wdt, c)
+    dw2 = _wgrad_taps(r2p, gy, bt, h, wdt, c)
+    ds1 = jnp.sum(da1 * x, axis=(0, 1, 2))
+    db1 = jnp.sum(da1, axis=(0, 1, 2))
+    ds2 = jnp.sum(da2 * c1, axis=(0, 1, 2))
+    db2 = jnp.sum(da2, axis=(0, 1, 2))
+
+    @pl.when(i == 0)
+    def _init():
+        dw1_ref[...] = dw1
+        dw2_ref[...] = dw2
+        ds1_ref[...] = ds1
+        db1_ref[...] = db1
+        ds2_ref[...] = ds2
+        db2_ref[...] = db2
+
+    @pl.when(i > 0)
+    def _acc():
+        dw1_ref[...] += dw1
+        dw2_ref[...] += dw2
+        ds1_ref[...] += ds1
+        db1_ref[...] += db1
+        ds2_ref[...] += ds2
+        db2_ref[...] += db2
+
+
+def _block_bwd_call(x, gy, w1, w2, s1, b1, s2, b2, *, batch_tile: int,
+                    interpret: bool):
+    interpret, bt, grid, tile, full, kwargs = _plumbing(
+        x, batch_tile, interpret)
+    c = x.shape[-1]
+    f32 = jnp.float32
+    return pl.pallas_call(
+        _block_bwd_kernel,
+        grid=grid,
+        in_specs=[tile, tile, full(3, 3, c, c), full(3, 3, c, c),
+                  full(c), full(c), full(c), full(c)],
+        out_specs=[tile, full(3, 3, c, c), full(3, 3, c, c),
+                   full(c), full(c), full(c), full(c)],
+        out_shape=[jax.ShapeDtypeStruct(x.shape, x.dtype),
+                   jax.ShapeDtypeStruct((3, 3, c, c), f32),
+                   jax.ShapeDtypeStruct((3, 3, c, c), f32),
+                   jax.ShapeDtypeStruct((c,), f32),
+                   jax.ShapeDtypeStruct((c,), f32),
+                   jax.ShapeDtypeStruct((c,), f32),
+                   jax.ShapeDtypeStruct((c,), f32)],
+        interpret=interpret,
+        **kwargs,
+    )(x, gy, w1, w2, s1, b1, s2, b2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def block_apply(x, w1, w2, s1, b1, s2, b2, batch_tile=16, interpret=None,
+                bwd_batch_tile=None):
+    """Differentiable fused block: Pallas forward + Pallas backward with
+    in-kernel activation recompute (only ``x`` is saved — no residual
+    tensors in HBM). Drop-in for ``block_fwd_reference`` under
+    ``jax.grad``.
+
+    ``bwd_batch_tile`` (default: ``batch_tile`` // 2, min 1) sizes the
+    backward kernel's tile separately — its VMEM live set is ~2-3× the
+    forward's (recomputed chain + gradient chain + wgrad accumulators),
+    so a forward-tuned tile can exceed the ~16 MB core VMEM."""
+    return block_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile=batch_tile,
+                     interpret=interpret)
+
+
+def _block_apply_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile, interpret,
+                     bwd_batch_tile):
+    y = block_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile=batch_tile,
+                  interpret=interpret)
+    return y, (x, w1, w2, s1, b1, s2, b2)
+
+
+def _block_apply_bwd(batch_tile, interpret, bwd_batch_tile, res, gy):
+    x, w1, w2, s1, b1, s2, b2 = res
+    if bwd_batch_tile is None:
+        bwd_batch_tile = max(1, batch_tile // 2)
+    dx, dw1, dw2, ds1, db1, ds2, db2 = _block_bwd_call(
+        x, gy, w1, w2, s1, b1, s2, b2, batch_tile=bwd_batch_tile,
+        interpret=interpret)
+    return (dx, dw1.astype(w1.dtype), dw2.astype(w2.dtype),
+            ds1.astype(s1.dtype), db1.astype(b1.dtype),
+            ds2.astype(s2.dtype), db2.astype(b2.dtype))
+
+
+block_apply.defvjp(_block_apply_fwd, _block_apply_bwd)
