@@ -23,6 +23,19 @@ result (docs/PERF.md) and this file stays an exemplar.
 
 Reference block semantics: v2 preactivation residual block,
 reference resnet_model_official.py:144-186 (building_block_v2).
+
+Training-path integration plan (round 4, contingent on the A/B): live
+batch stats fold into this design as a two-pass block. BN1's stats are
+moments of the block input x (available before the kernel); BN2's are
+moments of conv1's output c1, which is produced inside the block — so
+pass A runs the tile grid accumulating c1's sum/sum-of-squares (c1 is
+recomputed, never written to HBM), pass B runs this kernel with both
+stats folded to scale/bias. HBM traffic: two reads of x + one write of
+y per block, still far below XLA's per-op materialization. The backward
+gains the standard BN batch-stats correction terms (dmean/dvar chain)
+in the same recompute style. Eval-path integration needs no new math:
+inference BN is exactly the folded scale/bias this kernel already takes
+(scale = gamma/sqrt(var+eps), bias = beta - gamma*mean/sqrt(var+eps)).
 """
 
 from __future__ import annotations
