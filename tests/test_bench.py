@@ -128,3 +128,14 @@ def test_measure_record_split():
     out = bench._measure_record_split(n_records=40)
     assert out["native_crc_mb_per_sec"] > 0
     assert out["python_crc_mb_per_sec"] > 0
+
+
+def test_fetch_sync_returns_scalar():
+    """_fetch_sync is the timing barrier every timed loop closes over
+    (block_until_ready was observed resolving early on a degrading
+    tunnel) — it must force a host value out of any scalar-shaped JAX
+    array."""
+    import jax.numpy as jnp
+
+    v = bench._fetch_sync(jnp.float32(3.5))
+    assert isinstance(v, float) and v == 3.5
