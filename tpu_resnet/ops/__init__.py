@@ -1,6 +1,11 @@
 """Pallas TPU kernels for hot ops. Each op has an interpret-mode path so the
 same kernel code runs (slowly) on CPU in tests."""
 
+from tpu_resnet.ops.fused_block import (
+    block_apply,
+    block_fwd,
+    block_fwd_reference,
+)
 from tpu_resnet.ops.softmax_xent import (
     is_tpu_backend,
     make_pallas_xent,
@@ -8,5 +13,6 @@ from tpu_resnet.ops.softmax_xent import (
     softmax_xent_per_example,
 )
 
-__all__ = ["is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
+__all__ = ["block_apply", "block_fwd", "block_fwd_reference",
+           "is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
            "softmax_xent_per_example"]
