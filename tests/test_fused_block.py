@@ -1,6 +1,6 @@
 """ops/fused_block.py — interpret-mode correctness of the experimental
 fused v2 basic-block forward vs the XLA reference (its first TPU run
-happens unattended in battery stage 80; this keeps that from being its
+happens unattended in battery stage 32; this keeps that from being its
 first run ever)."""
 
 import jax
