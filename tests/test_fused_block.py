@@ -68,7 +68,7 @@ def test_ab_harness_tiny(tmp_path, monkeypatch):
         "--dtype", "float32", "--out", str(out)])
     fused_block_ab.main()
     got = json.load(open(out))["by_shape"]["b8_8x8x16"]
-    for direction in ("fwd", "fwd_bwd"):
+    for direction in ("fwd", "fwd_bwd", "train_fwd_live_bn"):
         e = got[direction]
         assert e["pallas_us_per_block"] > 0 and e["xla_us_per_block"] > 0
 
@@ -109,3 +109,27 @@ def test_block_apply_value_matches_fwd():
         block_apply(x, *params, 2, True, 2),
         block_fwd(x, *params, batch_tile=2, interpret=True), rtol=0,
         atol=0)
+
+
+def test_block_train_fwd_matches_reference():
+    """Two-pass live-batch-stats block (stats kernel + folded apply) vs
+    the XLA training-BN oracle: output and all four returned moments,
+    across batch tiles."""
+    from tpu_resnet.ops.fused_block import (block_train_fwd,
+                                            block_train_fwd_reference)
+
+    rng = np.random.default_rng(9)
+    c = 16
+    x = jnp.asarray(rng.normal(size=(4, 8, 8, c)) * 2 + 1, jnp.float32)
+    gb = lambda lo, hi: jnp.asarray(rng.uniform(lo, hi, c), jnp.float32)
+    args = (jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.2, jnp.float32),
+            jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.2, jnp.float32),
+            gb(0.5, 1.5), gb(-0.3, 0.3), gb(0.5, 1.5), gb(-0.3, 0.3))
+
+    y, moms = block_train_fwd(x, *args, batch_tile=2, interpret=True)
+    y_ref, moms_ref = block_train_fwd_reference(x, *args)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-4, atol=1e-4)
+    for name, m, mr in zip(("mean1", "var1", "mean2", "var2"),
+                           moms, moms_ref):
+        np.testing.assert_allclose(m, mr, rtol=1e-4, atol=1e-5,
+                                   err_msg=name)

@@ -53,7 +53,9 @@ def main():
 
     import bench
     from tpu_resnet.ops.fused_block import (block_apply, block_fwd,
-                                            block_fwd_reference)
+                                            block_fwd_reference,
+                                            block_train_fwd,
+                                            block_train_fwd_reference)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     out = {"device": jax.devices()[0].device_kind, "length": args.length,
@@ -128,6 +130,32 @@ def main():
                 "pallas_us_per_block": round(pallas_g_us, 2),
                 "xla_us_per_block": round(xla_g_us, 2),
                 "speedup": round(xla_g_us / pallas_g_us, 3)}
+            out["by_shape"][key] = entry
+            flush()
+
+            # Training forward with LIVE batch stats (two-pass: stats
+            # kernel + folded apply) — does the stats pass eat the win?
+            gb = (jnp.ones((c,), dtype), jnp.zeros((c,), dtype),
+                  jnp.ones((c,), dtype), jnp.zeros((c,), dtype))
+            w12 = params[:2]
+
+            def chained_train(block):
+                @jax.jit
+                def run(x):
+                    def body(xc, _):
+                        y, _moms = block(xc, *w12, *gb)
+                        return y, None
+                    xc, _ = jax.lax.scan(body, x, None, length=args.length)
+                    return jnp.float32(jnp.sum(xc))
+                return run
+
+            pallas_t_us = time_arm(chained_train(
+                lambda x, *p: block_train_fwd(x, *p, batch_tile=bt_fwd)))
+            xla_t_us = time_arm(chained_train(block_train_fwd_reference))
+            entry["train_fwd_live_bn"] = {
+                "pallas_us_per_block": round(pallas_t_us, 2),
+                "xla_us_per_block": round(xla_t_us, 2),
+                "speedup": round(xla_t_us / pallas_t_us, 3)}
         except Exception as e:  # record and keep measuring other shapes
             out["by_shape"].setdefault(key, {})["error"] = (
                 f"{type(e).__name__}: {e}"[:500])

@@ -5,6 +5,8 @@ from tpu_resnet.ops.fused_block import (
     block_apply,
     block_fwd,
     block_fwd_reference,
+    block_train_fwd,
+    block_train_fwd_reference,
 )
 from tpu_resnet.ops.softmax_xent import (
     is_tpu_backend,
@@ -14,5 +16,6 @@ from tpu_resnet.ops.softmax_xent import (
 )
 
 __all__ = ["block_apply", "block_fwd", "block_fwd_reference",
+           "block_train_fwd", "block_train_fwd_reference",
            "is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
            "softmax_xent_per_example"]

@@ -273,6 +273,113 @@ def _block_bwd_call(x, gy, w1, w2, s1, b1, s2, b2, *, batch_tile: int,
     )(x, gy, w1, w2, s1, b1, s2, b2)
 
 
+# --------------------------------------------------------------------------
+# Training forward with LIVE batch stats: the two-pass block
+# --------------------------------------------------------------------------
+#
+# BN1 normalizes the block input x — its moments are one cheap XLA
+# reduction. BN2 normalizes conv1's output c1, which this design never
+# materializes in HBM: pass A (_stats_kernel) recomputes c1 per tile and
+# accumulates its per-channel sum / sum-of-squares across the sequential
+# grid; pass B is the folded-scale/bias block kernel above. HBM traffic
+# per block: three reads of x (BN1 moments, stats pass, apply pass) and
+# one write of y — still far below per-op materialization, and the BN1
+# reduction could later fold into the previous block's epilogue.
+
+
+def _stats_kernel(x_ref, w1_ref, s1_ref, b1_ref, sum_ref, sumsq_ref):
+    bt, h, wdt, c = x_ref.shape
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    pre1 = _scale_bias_relu(x, s1_ref[...], b1_ref[...])
+    pre1 = jnp.pad(pre1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    c1 = _conv3x3_taps(pre1, w1_ref[...].astype(jnp.float32),
+                       bt, h, wdt, c)
+    s = jnp.sum(c1, axis=(0, 1, 2))
+    ss = jnp.sum(c1 * c1, axis=(0, 1, 2))
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = s
+        sumsq_ref[...] = ss
+
+    @pl.when(i > 0)
+    def _acc():
+        sum_ref[...] += s
+        sumsq_ref[...] += ss
+
+
+def _c1_moments(x, w1, s1, b1, *, batch_tile, interpret):
+    interpret, bt, grid, tile, full, kwargs = _plumbing(
+        x, batch_tile, interpret)
+    c = x.shape[-1]
+    f32 = jnp.float32
+    s, ss = pl.pallas_call(
+        _stats_kernel,
+        grid=grid,
+        in_specs=[tile, full(3, 3, c, c), full(c), full(c)],
+        out_specs=[full(c), full(c)],
+        out_shape=[jax.ShapeDtypeStruct((c,), f32),
+                   jax.ShapeDtypeStruct((c,), f32)],
+        interpret=interpret,
+        **kwargs,
+    )(x, w1, s1, b1)
+    n = x.shape[0] * x.shape[1] * x.shape[2]
+    mean = s / n
+    # Single-pass variance can go slightly negative under fp32
+    # cancellation (large mean, tiny variance); clamped so rsqrt(var+eps)
+    # can't NaN where the two-pass jnp.var wouldn't.
+    var = jnp.maximum(ss / n - mean * mean, 0.0)
+    return mean, var
+
+
+def _fold(gamma, beta, mean, var, eps):
+    scale = gamma * jax.lax.rsqrt(var + eps)
+    return scale, beta - mean * scale
+
+
+def block_train_fwd(x, w1, w2, gamma1, beta1, gamma2, beta2,
+                    eps: float = 1e-5, *, batch_tile: int = 16,
+                    interpret: bool | None = None):
+    """Fused v2 basic block with LIVE batch-norm statistics (training
+    semantics, biased variance like flax BatchNorm's batch moments).
+
+    Returns ``(y, (mean1, var1, mean2, var2))`` — the moments feed the
+    caller's running-stats EMA exactly as the unfused BN layers would
+    (reference resnet_model.py:39-57 batch_norm_relu)."""
+    xf = x.astype(jnp.float32)
+    mean1 = jnp.mean(xf, axis=(0, 1, 2))
+    var1 = jnp.var(xf, axis=(0, 1, 2))
+    s1, b1 = _fold(gamma1, beta1, mean1, var1, eps)
+    mean2, var2 = _c1_moments(x, w1, s1, b1, batch_tile=batch_tile,
+                              interpret=interpret)
+    s2, b2 = _fold(gamma2, beta2, mean2, var2, eps)
+    y = block_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile=batch_tile,
+                  interpret=interpret)
+    return y, (mean1, var1, mean2, var2)
+
+
+@jax.jit
+def block_train_fwd_reference(x, w1, w2, gamma1, beta1, gamma2, beta2,
+                              eps: float = 1e-5):
+    """XLA oracle: the same training-BN block with batch moments."""
+    xf = x.astype(jnp.float32)
+    dn = ("NHWC", "HWIO", "NHWC")
+    mean1 = jnp.mean(xf, axis=(0, 1, 2))
+    var1 = jnp.var(xf, axis=(0, 1, 2))
+    pre1 = jnp.maximum(
+        (xf - mean1) * jax.lax.rsqrt(var1 + eps) * gamma1 + beta1, 0.0)
+    c1 = jax.lax.conv_general_dilated(
+        pre1, w1.astype(jnp.float32), (1, 1), "SAME", dimension_numbers=dn)
+    mean2 = jnp.mean(c1, axis=(0, 1, 2))
+    var2 = jnp.var(c1, axis=(0, 1, 2))
+    pre2 = jnp.maximum(
+        (c1 - mean2) * jax.lax.rsqrt(var2 + eps) * gamma2 + beta2, 0.0)
+    out = jax.lax.conv_general_dilated(
+        pre2, w2.astype(jnp.float32), (1, 1), "SAME", dimension_numbers=dn)
+    return (xf + out).astype(x.dtype), (mean1, var1, mean2, var2)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
 def block_apply(x, w1, w2, s1, b1, s2, b2, batch_tile=16, interpret=None,
                 bwd_batch_tile=None):
