@@ -68,7 +68,8 @@ def test_ab_harness_tiny(tmp_path, monkeypatch):
         "--dtype", "float32", "--out", str(out)])
     fused_block_ab.main()
     got = json.load(open(out))["by_shape"]["b8_8x8x16"]
-    for direction in ("fwd", "fwd_bwd", "train_fwd_live_bn"):
+    for direction in ("fwd", "fwd_bwd", "train_fwd_live_bn",
+                      "train_fwd_bwd_live_bn"):
         e = got[direction]
         assert e["pallas_us_per_block"] > 0 and e["xla_us_per_block"] > 0
 
@@ -133,3 +134,48 @@ def test_block_train_fwd_matches_reference():
                            moms, moms_ref):
         np.testing.assert_allclose(m, mr, rtol=1e-4, atol=1e-5,
                                    err_msg=name)
+
+
+def test_block_train_apply_grads_match_reference():
+    """Training-path custom VJP (three-pass Pallas backward with the BN
+    batch-moment correction terms) vs jax.grad of the live-BN XLA
+    oracle — all seven gradients, across batch tiles."""
+    from tpu_resnet.ops.fused_block import (block_train_apply,
+                                            block_train_fwd_reference)
+
+    rng = np.random.default_rng(11)
+    c = 16
+    x = jnp.asarray(rng.normal(size=(4, 8, 8, c)) * 2 + 1, jnp.float32)
+    gb = lambda lo, hi: jnp.asarray(rng.uniform(lo, hi, c), jnp.float32)
+    args = (jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.2, jnp.float32),
+            jnp.asarray(rng.normal(size=(3, 3, c, c)) * 0.2, jnp.float32),
+            gb(0.5, 1.5), gb(-0.3, 0.3), gb(0.5, 1.5), gb(-0.3, 0.3))
+
+    def loss_fused(x, *p):
+        y, _moms = block_train_apply(x, *p, 1e-5, 2, True)
+        return jnp.sum(y ** 2)
+
+    def loss_ref(x, *p):
+        y, _moms = block_train_fwd_reference(x, *p)
+        return jnp.sum(y ** 2)
+
+    got = jax.grad(loss_fused, argnums=tuple(range(7)))(x, *args)
+    want = jax.grad(loss_ref, argnums=tuple(range(7)))(x, *args)
+    names = ("dx", "dw1", "dw2", "dgamma1", "dbeta1", "dgamma2", "dbeta2")
+    for name, g, w in zip(names, got, want):
+        np.testing.assert_allclose(g, w, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+
+def test_bwd_tile_defaults_divide_odd_batches():
+    """Review regression: a batch the forward accepts (b=12, tile=16 ->
+    bt=12) must not crash at jax.grad time when the backward halves the
+    tile (16//2=8 does not divide 12; the default picks a divisor)."""
+    from tpu_resnet.ops.fused_block import block_apply
+
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(size=(12, 4, 4, 16)), jnp.float32)
+    params = _params(16, seed=14)
+    g = jax.grad(
+        lambda x: jnp.sum(block_apply(x, *params, 16, True, None) ** 2))(x)
+    assert np.isfinite(np.asarray(g)).all()

@@ -54,6 +54,7 @@ def main():
     import bench
     from tpu_resnet.ops.fused_block import (block_apply, block_fwd,
                                             block_fwd_reference,
+                                            block_train_apply,
                                             block_train_fwd,
                                             block_train_fwd_reference)
 
@@ -86,20 +87,23 @@ def main():
                     return jnp.float32(jnp.sum(xc))
                 return run
 
-            def chained_grad(block):
+            def chained_grad(block, block_params, tuple_out=False):
                 # Params are loss ARGUMENTS (argnums 0..6): both arms must
-                # compute dx and all six parameter grads.
+                # compute dx and all six parameter grads. tuple_out: the
+                # live-BN blocks return (y, moments); moments are unused
+                # (stop-gradient EMA convention).
                 def loss(x, *p):
                     def body(xc, _):
-                        return block(xc, *p), None
+                        y = block(xc, *p)
+                        return (y[0] if tuple_out else y), None
                     xc, _ = jax.lax.scan(body, x, None, length=args.length)
                     return jnp.float32(jnp.sum(xc))
 
-                g = jax.grad(loss, argnums=tuple(range(7)))
+                g = jax.grad(loss, argnums=tuple(range(1 + len(block_params))))
 
                 @jax.jit
                 def run(x):
-                    grads = g(x, *params)
+                    grads = g(x, *block_params)
                     return sum(jnp.float32(jnp.sum(gr)) for gr in grads)
                 return run
 
@@ -124,13 +128,13 @@ def main():
             flush()  # fwd numbers survive a bwd failure
 
             pallas_g_us = time_arm(chained_grad(
-                lambda x, *p: block_apply(x, *p, bt_fwd, None, bt_bwd)))
-            xla_g_us = time_arm(chained_grad(block_fwd_reference))
+                lambda x, *p: block_apply(x, *p, bt_fwd, None, bt_bwd),
+                params))
+            xla_g_us = time_arm(chained_grad(block_fwd_reference, params))
             entry["fwd_bwd"] = {
                 "pallas_us_per_block": round(pallas_g_us, 2),
                 "xla_us_per_block": round(xla_g_us, 2),
                 "speedup": round(xla_g_us / pallas_g_us, 3)}
-            out["by_shape"][key] = entry
             flush()
 
             # Training forward with LIVE batch stats (two-pass: stats
@@ -156,6 +160,21 @@ def main():
                 "pallas_us_per_block": round(pallas_t_us, 2),
                 "xla_us_per_block": round(xla_t_us, 2),
                 "speedup": round(xla_t_us / pallas_t_us, 3)}
+            flush()
+
+            # The end-to-end training direction: fwd+bwd with live BN —
+            # the number that decides model integration.
+            train_params = (*w12, *gb)
+            pallas_tg_us = time_arm(chained_grad(
+                lambda x, *p: block_train_apply(
+                    x, *p, 1e-5, bt_fwd, None),
+                train_params, tuple_out=True))
+            xla_tg_us = time_arm(chained_grad(
+                block_train_fwd_reference, train_params, tuple_out=True))
+            entry["train_fwd_bwd_live_bn"] = {
+                "pallas_us_per_block": round(pallas_tg_us, 2),
+                "xla_us_per_block": round(xla_tg_us, 2),
+                "speedup": round(xla_tg_us / pallas_tg_us, 3)}
         except Exception as e:  # record and keep measuring other shapes
             out["by_shape"].setdefault(key, {})["error"] = (
                 f"{type(e).__name__}: {e}"[:500])

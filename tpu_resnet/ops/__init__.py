@@ -3,6 +3,7 @@ same kernel code runs (slowly) on CPU in tests."""
 
 from tpu_resnet.ops.fused_block import (
     block_apply,
+    block_train_apply,
     block_fwd,
     block_fwd_reference,
     block_train_fwd,
@@ -16,6 +17,7 @@ from tpu_resnet.ops.softmax_xent import (
 )
 
 __all__ = ["block_apply", "block_fwd", "block_fwd_reference",
+           "block_train_apply",
            "block_train_fwd", "block_train_fwd_reference",
            "is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
            "softmax_xent_per_example"]

@@ -90,6 +90,16 @@ def _block_kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref,
     o_ref[...] = (x + out).astype(o_ref.dtype)
 
 
+def _default_bwd_tile(batch: int, fwd_tile: int) -> int:
+    """Largest divisor of ``batch`` that is <= fwd_tile // 2 (the backward
+    kernels keep ~2-3x the forward's live set, and the tile must divide
+    the batch or _plumbing raises at jax.grad time)."""
+    target = max(1, min(batch, fwd_tile // 2))
+    while batch % target:
+        target -= 1
+    return target
+
+
 def _plumbing(x, batch_tile, interpret):
     """Shared pallas_call scaffolding for the fwd and bwd kernels:
     (resolved interpret, batch tile, grid, tile BlockSpec, whole-array
@@ -229,23 +239,8 @@ def _block_bwd_kernel(x_ref, gy_ref, w1_ref, w2_ref, s1_ref, b1_ref,
     ds2 = jnp.sum(da2 * c1, axis=(0, 1, 2))
     db2 = jnp.sum(da2, axis=(0, 1, 2))
 
-    @pl.when(i == 0)
-    def _init():
-        dw1_ref[...] = dw1
-        dw2_ref[...] = dw2
-        ds1_ref[...] = ds1
-        db1_ref[...] = db1
-        ds2_ref[...] = ds2
-        db2_ref[...] = db2
-
-    @pl.when(i > 0)
-    def _acc():
-        dw1_ref[...] += dw1
-        dw2_ref[...] += dw2
-        ds1_ref[...] += ds1
-        db1_ref[...] += db1
-        ds2_ref[...] += ds2
-        db2_ref[...] += db2
+    _acc_out(i, (dw1_ref, dw2_ref, ds1_ref, db1_ref, ds2_ref, db2_ref),
+             (dw1, dw2, ds1, db1, ds2, db2))
 
 
 def _block_bwd_call(x, gy, w1, w2, s1, b1, s2, b2, *, batch_tile: int,
@@ -274,6 +269,192 @@ def _block_bwd_call(x, gy, w1, w2, s1, b1, s2, b2, *, batch_tile: int,
 
 
 # --------------------------------------------------------------------------
+# Training-path backward: BN batch-stats corrections, three tile passes
+# --------------------------------------------------------------------------
+#
+# With live moments, BN's VJP carries batch-wide correction terms: for
+# z = γ·(u-m)/σ + β (biased variance, N elements/channel),
+#   du = γ/σ · (dz − ΣB dz / N − ẑ · ΣB dz⊙ẑ / N),
+# and the two sums are exactly dβ and dγ. The sums are over the WHOLE
+# batch, so the sequential tile grid needs a pass boundary before using
+# them. Three passes, each recomputing the forward chain in VMEM from
+# (x, params, saved moments):
+#   pass 1: accumulate T1=Σdz2, T2=Σdz2⊙ẑ2 and dw2   (dγ2=T2, dβ2=T1)
+#   pass 2: finish dc1 with T1/T2; accumulate U1=Σdz1, U2=Σdz1⊙ẑ1 and
+#           dw1                                        (dγ1=U2, dβ1=U1)
+#   pass 3: finish dx with U1/U2.
+# The moments output of block_train_fwd gets a zero cotangent by
+# convention: running-stats EMA updates are stop-gradient in BN training
+# semantics (flax's mutable batch_stats likewise).
+
+
+def _recompute_train(x, w1, g1, b1, g2, b2, m1, i1, m2, i2,
+                     bt, h, wdt, c):
+    """Forward chain from the block input and SAVED moments (i = 1/σ);
+    shared by all three backward passes."""
+    z1hat = (x - m1) * i1
+    z1 = g1 * z1hat + b1
+    r1 = jnp.maximum(z1, 0.0)
+    r1p = jnp.pad(r1, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    c1 = _conv3x3_taps(r1p, w1, bt, h, wdt, c)
+    z2hat = (c1 - m2) * i2
+    z2 = g2 * z2hat + b2
+    r2 = jnp.maximum(z2, 0.0)
+    r2p = jnp.pad(r2, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    return z1, z1hat, r1p, z2, z2hat, r2p
+
+
+def _acc_out(i, refs, vals):
+    @pl.when(i == 0)
+    def _init():
+        for ref, v in zip(refs, vals):
+            ref[...] = v
+
+    @pl.when(i > 0)
+    def _acc():
+        for ref, v in zip(refs, vals):
+            ref[...] += v
+
+
+def _train_bwd_calls(x, gy, w1, w2, g1, b1, g2, b2, moments, eps, *,
+                     batch_tile, interpret):
+    m1, v1, m2, v2 = moments
+    i1 = jax.lax.rsqrt(v1 + eps)
+    i2 = jax.lax.rsqrt(v2 + eps)
+    interpret, bt, grid, tile, full, kwargs = _plumbing(
+        x, batch_tile, interpret)
+    b, h, wdt, c = x.shape
+    n = float(b * h * wdt)
+    f32 = jnp.float32
+    # x, gy, w1, w2, then the 8 [C] vectors g1,b1,g2,b2,m1,i1,m2,i2
+    base_in = ([tile, tile, full(3, 3, c, c), full(3, 3, c, c)]
+               + [full(c)] * 8)
+    wshape = jax.ShapeDtypeStruct((3, 3, c, c), f32)
+    cshape = jax.ShapeDtypeStruct((c,), f32)
+
+    def load(refs):
+        (x_ref, gy_ref, w1_ref, w2_ref, g1_ref, b1_ref, g2_ref, b2_ref,
+         m1_ref, i1_ref, m2_ref, i2_ref) = refs
+        return (x_ref[...].astype(f32), gy_ref[...].astype(f32),
+                w1_ref[...].astype(f32), w2_ref[...].astype(f32),
+                g1_ref[...], b1_ref[...], g2_ref[...], b2_ref[...],
+                m1_ref[...], i1_ref[...], m2_ref[...], i2_ref[...])
+
+    def pass1(*refs):
+        (t1_ref, t2_ref, dw2_ref) = refs[-3:]
+        xv, gyv, w1v, w2v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v = \
+            load(refs[:-3])
+        _, _, _, z2, z2hat, r2p = _recompute_train(
+            xv, w1v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v, bt, h, wdt, c)
+        gyp = jnp.pad(gyv, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        dr2 = _conv3x3_taps(gyp, _transpose_weights(w2v), bt, h, wdt, c)
+        dz2 = jnp.where(z2 > 0, dr2, 0.0)
+        _acc_out(pl.program_id(0), (t1_ref, t2_ref, dw2_ref),
+                 (jnp.sum(dz2, axis=(0, 1, 2)),
+                  jnp.sum(dz2 * z2hat, axis=(0, 1, 2)),
+                  _wgrad_taps(r2p, gyv, bt, h, wdt, c)))
+
+    t1, t2, dw2 = pl.pallas_call(
+        pass1, grid=grid, in_specs=base_in,
+        out_specs=[full(c), full(c), full(3, 3, c, c)],
+        out_shape=[cshape, cshape, wshape],
+        interpret=interpret, **kwargs,
+    )(x, gy, w1, w2, g1, b1, g2, b2, m1, i1, m2, i2)
+
+    def _dc1(z2, z2hat, gyv, w2v, g2v, i2v, t1v, t2v):
+        dr2 = _conv3x3_taps(
+            jnp.pad(gyv, ((0, 0), (1, 1), (1, 1), (0, 0))),
+            _transpose_weights(w2v), bt, h, wdt, c)
+        dz2 = jnp.where(z2 > 0, dr2, 0.0)
+        return g2v * i2v * (dz2 - t1v / n - z2hat * (t2v / n))
+
+    def pass2(*refs):
+        (u1_ref, u2_ref, dw1_ref) = refs[-3:]
+        t1_ref, t2_ref = refs[-5:-3]
+        xv, gyv, w1v, w2v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v = \
+            load(refs[:-5])
+        z1, z1hat, r1p, z2, z2hat, _ = _recompute_train(
+            xv, w1v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v, bt, h, wdt, c)
+        dc1 = _dc1(z2, z2hat, gyv, w2v, g2v, i2v, t1_ref[...], t2_ref[...])
+        dr1 = _conv3x3_taps(
+            jnp.pad(dc1, ((0, 0), (1, 1), (1, 1), (0, 0))),
+            _transpose_weights(w1v), bt, h, wdt, c)
+        dz1 = jnp.where(z1 > 0, dr1, 0.0)
+        _acc_out(pl.program_id(0), (u1_ref, u2_ref, dw1_ref),
+                 (jnp.sum(dz1, axis=(0, 1, 2)),
+                  jnp.sum(dz1 * z1hat, axis=(0, 1, 2)),
+                  _wgrad_taps(r1p, dc1, bt, h, wdt, c)))
+
+    u1, u2, dw1 = pl.pallas_call(
+        pass2, grid=grid, in_specs=base_in + [full(c), full(c)],
+        out_specs=[full(c), full(c), full(3, 3, c, c)],
+        out_shape=[cshape, cshape, wshape],
+        interpret=interpret, **kwargs,
+    )(x, gy, w1, w2, g1, b1, g2, b2, m1, i1, m2, i2, t1, t2)
+
+    def pass3(*refs):
+        dx_ref = refs[-1]
+        t1_ref, t2_ref, u1_ref, u2_ref = refs[-5:-1]
+        xv, gyv, w1v, w2v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v = \
+            load(refs[:-5])
+        z1, z1hat, _, z2, z2hat, _ = _recompute_train(
+            xv, w1v, g1v, b1v, g2v, b2v, m1v, i1v, m2v, i2v, bt, h, wdt, c)
+        dc1 = _dc1(z2, z2hat, gyv, w2v, g2v, i2v, t1_ref[...], t2_ref[...])
+        dr1 = _conv3x3_taps(
+            jnp.pad(dc1, ((0, 0), (1, 1), (1, 1), (0, 0))),
+            _transpose_weights(w1v), bt, h, wdt, c)
+        dz1 = jnp.where(z1 > 0, dr1, 0.0)
+        dx = gyv + g1v * i1v[None, None, None, :] * (
+            dz1 - u1_ref[...] / n - z1hat * (u2_ref[...] / n))
+        dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    dx = pl.pallas_call(
+        pass3, grid=grid,
+        in_specs=base_in + [full(c), full(c), full(c), full(c)],
+        out_specs=tile,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret, **kwargs,
+    )(x, gy, w1, w2, g1, b1, g2, b2, m1, i1, m2, i2, t1, t2, u1, u2)
+
+    # dγ2 = T2, dβ2 = T1, dγ1 = U2, dβ1 = U1 — the correction sums.
+    return dx, dw1, dw2, u2, u1, t2, t1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def block_train_apply(x, w1, w2, gamma1, beta1, gamma2, beta2,
+                      eps=1e-5, batch_tile=16, interpret=None):
+    """Differentiable live-batch-stats fused block (training semantics):
+    Pallas two-pass forward + three-pass backward with the full BN
+    batch-moment correction terms. Returns ``(y, moments)``; the moments
+    output is stop-gradient (running-stats EMA convention)."""
+    return block_train_fwd(x, w1, w2, gamma1, beta1, gamma2, beta2, eps,
+                           batch_tile=batch_tile, interpret=interpret)
+
+
+def _block_train_fwd_rule(x, w1, w2, gamma1, beta1, gamma2, beta2, eps,
+                          batch_tile, interpret):
+    y, moments = block_train_fwd(x, w1, w2, gamma1, beta1, gamma2, beta2,
+                                 eps, batch_tile=batch_tile,
+                                 interpret=interpret)
+    return (y, moments), (x, w1, w2, gamma1, beta1, gamma2, beta2, moments)
+
+
+def _block_train_bwd_rule(eps, batch_tile, interpret, res, cot):
+    gy, _gmoments = cot  # moments cotangent dropped: EMA is stop-gradient
+    x, w1, w2, gamma1, beta1, gamma2, beta2, moments = res
+    bwd_tile = _default_bwd_tile(x.shape[0], batch_tile or 16)
+    dx, dw1, dw2, dg1, db1, dg2, db2 = _train_bwd_calls(
+        x, gy.astype(jnp.float32), w1, w2, gamma1, beta1, gamma2, beta2,
+        moments, eps, batch_tile=bwd_tile, interpret=interpret)
+    return (dx.astype(x.dtype), dw1.astype(w1.dtype), dw2.astype(w2.dtype),
+            dg1.astype(gamma1.dtype), db1.astype(beta1.dtype),
+            dg2.astype(gamma2.dtype), db2.astype(beta2.dtype))
+
+
+block_train_apply.defvjp(_block_train_fwd_rule, _block_train_bwd_rule)
+
+
+# --------------------------------------------------------------------------
 # Training forward with LIVE batch stats: the two-pass block
 # --------------------------------------------------------------------------
 #
@@ -295,18 +476,9 @@ def _stats_kernel(x_ref, w1_ref, s1_ref, b1_ref, sum_ref, sumsq_ref):
     pre1 = jnp.pad(pre1, ((0, 0), (1, 1), (1, 1), (0, 0)))
     c1 = _conv3x3_taps(pre1, w1_ref[...].astype(jnp.float32),
                        bt, h, wdt, c)
-    s = jnp.sum(c1, axis=(0, 1, 2))
-    ss = jnp.sum(c1 * c1, axis=(0, 1, 2))
-
-    @pl.when(i == 0)
-    def _init():
-        sum_ref[...] = s
-        sumsq_ref[...] = ss
-
-    @pl.when(i > 0)
-    def _acc():
-        sum_ref[...] += s
-        sumsq_ref[...] += ss
+    _acc_out(i, (sum_ref, sumsq_ref),
+             (jnp.sum(c1, axis=(0, 1, 2)),
+              jnp.sum(c1 * c1, axis=(0, 1, 2))))
 
 
 def _c1_moments(x, w1, s1, b1, *, batch_tile, interpret):
@@ -406,7 +578,7 @@ def _block_apply_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile, interpret,
 def _block_apply_bwd(batch_tile, interpret, bwd_batch_tile, res, gy):
     x, w1, w2, s1, b1, s2, b2 = res
     if bwd_batch_tile is None:
-        bwd_batch_tile = max(1, batch_tile // 2)
+        bwd_batch_tile = _default_bwd_tile(x.shape[0], batch_tile)
     dx, dw1, dw2, ds1, db1, ds2, db2 = _block_bwd_call(
         x, gy, w1, w2, s1, b1, s2, b2, batch_tile=bwd_batch_tile,
         interpret=interpret)
