@@ -1,4 +1,5 @@
-"""EXPERIMENTAL: ResNet-v2 basic-block forward as ONE Pallas TPU kernel.
+"""EXPERIMENTAL: the ResNet-v2 basic block as fused Pallas TPU kernels —
+forward, backward, and live-batch-stats training variants.
 
 Motivation (docs/PERF.md "CIFAR step is overhead-bound"): the CIFAR
 ResNet's 16/32/64-channel convolutions run ~3.7× above even the HBM
