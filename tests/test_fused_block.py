@@ -1,6 +1,6 @@
 """ops/fused_block.py — interpret-mode correctness of the experimental
 fused v2 basic-block forward vs the XLA reference (its first TPU run
-happens unattended in battery stage 32; this keeps that from being its
+happens unattended in battery stage 05_fused_block_ab; this keeps that from being its
 first run ever)."""
 
 import jax
@@ -49,7 +49,7 @@ def test_fused_block_rejects_ragged_tile():
 
 
 def test_ab_harness_tiny(tmp_path, monkeypatch):
-    """The battery-stage-80 harness runs unattended on a live window;
+    """The battery-stage-05_fused_block_ab harness runs unattended on a live window;
     drive its exact code path at tiny config first (same pattern as
     tests/test_streaming_gap_probe.py)."""
     import json

@@ -1,5 +1,5 @@
 """tools/streaming_gap_probe.py — the resident-vs-staged input-placement
-probe behind battery stage 44 (its first production run happens unattended
+probe behind battery stage 35_streaming_gap (its first production run happens unattended
 on a live TPU window; this keeps that from being its first run ever)."""
 
 import json

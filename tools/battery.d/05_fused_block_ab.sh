@@ -11,4 +11,4 @@ cd "$REPO"
 # 8 arms x 3 shapes = 24 scan-program compiles at ~30-40 s each on a
 # first-cache TPU run — 900 s would cut the decisive experiment short.
 timeout -k 30 1800 python tools/fused_block_ab.py \
-  --out docs/runs/fused_block_ab_r3.json | tail -8
+  --out docs/runs/fused_block_ab_r4.json | tail -8

@@ -9,8 +9,8 @@ set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$REPO"
 
-OUT="${1:-$REPO/docs/runs/watch_r3}"
+OUT="${1:-$REPO/docs/runs/watch_r4}"
 timeout -k 30 900 python tools/mfu_probe.py --preset cifar10 --batch 128 \
-  --out docs/runs/cifar_cost_r3.json \
-  --hlo-gz docs/runs/hlo_cifar_b128_r3.txt.gz \
+  --out docs/runs/cifar_cost_r4.json \
+  --hlo-gz docs/runs/hlo_cifar_b128_r4.txt.gz \
   --trace-dir "$OUT/cifar_trace_b128" | tail -20

@@ -40,6 +40,6 @@ best_streaming = max(stages.values())
 out["resident_vs_streaming"] = {
     "resident_best": best_resident, "streaming_best": best_streaming,
     "resident_wins": best_resident >= best_streaming}
-json.dump(out, open("docs/runs/sweeps_r3.json", "w"), indent=2)
+json.dump(out, open("docs/runs/sweeps_r4.json", "w"), indent=2)
 print("[sweeps]", json.dumps(out))
 EOF

@@ -9,4 +9,4 @@ REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$REPO"
 
 timeout -k 30 900 python tools/streaming_gap_probe.py \
-  --out docs/runs/streaming_gap_r3.json | tail -5
+  --out docs/runs/streaming_gap_r4.json | tail -5

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Recipe-scale schedule rehearsal on the live TPU (VERDICT r3 item 6):
+# the freq100 synthetic oracle stretched to the REAL CIFAR recipe shape —
+# piecewise LR with boundaries at 40k/60k/80k steps exactly per
+# resnet_cifar_train.py:302-311, checkpoint every 1000 steps, eval sidecar
+# polling live — so the exact production cadence the 93.6% reproduction
+# would use is exercised end to end. r3 only ever ran the compressed
+# 6k-step version (boundaries 3000/4500/5500); at the measured ~216 st/s
+# the full 90k-step run is ~7 min of chip compute plus ckpt/eval overhead.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+OUT="${1:-$REPO/docs/runs/watch_r4}"
+DEST="$REPO/docs/runs/recipe_rehearsal_r4"
+mkdir -p "$DEST"
+cd "$REPO"
+
+RUN=/tmp/recipe_rehearsal
+# The trainer auto-resumes from the latest checkpoint in train_dir
+# (train/loop.py) — if a window closed mid-run, keep the partial run so the
+# next window continues from the last 1000-step checkpoint instead of
+# restarting a 90k-step stage from zero. Only wipe a dir with no checkpoint.
+if [ -d "$RUN" ] && find "$RUN" -maxdepth 1 -type d -name '[0-9]*' | grep -q .; then
+  echo "[recipe_rehearsal] resuming from existing checkpoints in $RUN"
+else
+  rm -rf "$RUN"
+fi
+timeout -k 30 3600 python -m tpu_resnet train_and_eval --preset smoke \
+  data.synthetic_learnable=true data.synthetic_task=freq100 \
+  data.synthetic_classes=100 data.synthetic_label_noise=0.1 \
+  data.synthetic_train_examples=20480 data.synthetic_eval_examples=2048 \
+  model.resnet_size=20 model.compute_dtype=bfloat16 \
+  train.global_batch_size=128 train.eval_batch_size=128 \
+  train.train_steps=90000 train.checkpoint_every=1000 train.log_every=500 \
+  train.image_summary_every=0 \
+  optim.schedule=cifar_piecewise "optim.boundaries=(40000,60000,80000)" \
+  "optim.values=(0.1,0.01,0.001,0.0001)" \
+  train.train_dir="$RUN" 2>&1 | tail -8
+
+cp "$RUN/metrics.jsonl" "$DEST/train_metrics.jsonl"
+cp "$RUN/eval/metrics.jsonl" "$DEST/eval_metrics.jsonl" 2>/dev/null || true
+cp "$RUN/eval/best_precision.json" "$DEST/" 2>/dev/null || true
+python -m tpu_resnet plot --dir "$RUN" \
+  --out "$DEST/curves.png" --csv "$DEST/series.csv" || true
+
+# Decay-boundary evidence: the loss/precision series must show jumps at
+# the recipe steps, not just end-state accuracy.
+python - "$DEST" <<'EOF'
+import json, sys, os
+dest = sys.argv[1]
+recs = [json.loads(l) for l in open(os.path.join(dest, "train_metrics.jsonl"))]
+recs = [r for r in recs if "loss" in r]
+def win(lo, hi):
+    xs = [r["loss"] for r in recs if lo <= r["step"] <= hi]
+    return round(sum(xs) / len(xs), 4) if xs else None
+summary = {
+    "what": "freq100 oracle at the real 40k/60k/80k recipe cadence "
+            "(resnet_cifar_train.py:302-311), ckpt every 1000, live eval sidecar",
+    "steps": recs[-1]["step"] if recs else 0,
+    "loss_pre_40k": win(35000, 40000), "loss_post_40k": win(41000, 46000),
+    "loss_pre_60k": win(55000, 60000), "loss_post_60k": win(61000, 66000),
+    "loss_pre_80k": win(75000, 80000), "loss_post_80k": win(81000, 86000),
+    "final_train_precision": recs[-1].get("precision") if recs else None,
+}
+best = os.path.join(dest, "best_precision.json")
+if os.path.exists(best):
+    summary["eval_best"] = json.load(open(best))
+json.dump(summary, open(os.path.join(dest, "summary.json"), "w"), indent=2)
+print("[recipe_rehearsal]", json.dumps(summary))
+EOF

@@ -4,7 +4,7 @@
 # to the step budget, reference resnet_cifar_train.py:302-311) vs
 # constant LR, identical everything else. CPU-mesh scale (resnet8 b64
 # 1200 steps) so it runs without a TPU window; the TPU-scale version is
-# battery stage 30_convergence. The piecewise arm's config is identical
+# the r3 battery convergence stage (artifacts: docs/runs/convergence_freq100). The piecewise arm's config is identical
 # to tools/convergence_bn_delta.sh's bn_sync arm — if that artifact
 # exists it is reused rather than re-run.
 #

@@ -3,12 +3,19 @@
 # live. Stages are checkpointed with marker files so a window that closes
 # mid-battery resumes where it left off on the next live window instead of
 # redoing finished work. Results are archived under docs/runs/.
+#
+# Round 4 restructure: the previously-hardcoded bench stage moved into
+# tools/battery.d/10_bench.sh so filename order fully controls priority —
+# the fused-block A/B (05_) is the round's decisive experiment (VERDICT r3
+# item 1) and must own the front of the first live window, ahead of the
+# headline bench.
+#
 # pipefail matters: stage results are piped through tee, and without it
 # the `if` below tests tee's status — a failed stage would be marked done
 # (exactly how the r3 stage-20 OOM slipped through on the first window).
 set -u -o pipefail
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r3}"
+OUT="${1:-$REPO/docs/runs/watch_r4}"
 RUNS="$REPO/docs/runs"
 mkdir -p "$OUT" "$RUNS"
 cd "$REPO"
@@ -28,34 +35,6 @@ alive() {
   timeout -k 10 45 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
-# -- stage 1: full bench.py (headline artifact) ---------------------------
-if ! stage_done bench; then
-  echo "[battery] stage bench: python bench.py"
-  # The OUTER watcher owns polling: short window, no CPU fallback —
-  # if the tunnel died between the watcher's probe and here, return to
-  # the poll loop instead of nesting bench.py's own 1h watch inside it.
-  BENCH_PROBE_TIMEOUT=60 BENCH_TPU_ATTEMPTS=2 \
-  BENCH_WATCH_WINDOW=180 BENCH_CPU_FALLBACK=0 \
-    python bench.py >"$OUT/bench.json" 2>"$OUT/bench.stderr"
-  rc=$?
-  if [ $rc -eq 0 ] && python - "$OUT/bench.json" <<'EOF'
-import json, sys
-r = json.load(open(sys.argv[1]))
-ok = r.get("backend") == "tpu" and not r.get("partial")
-sys.exit(0 if ok else 1)
-EOF
-  then
-    cp "$OUT/bench.json" "$RUNS/bench_r3_tpu_v5e.json"
-    cp "$OUT/bench.stderr" "$RUNS/bench_r3_tpu_v5e.log"
-    mark_done bench
-    echo "[battery] bench complete -> docs/runs/bench_r3_tpu_v5e.json"
-  else
-    echo "[battery] bench rc=$rc or partial — will retry next window"
-    alive || exit 0
-  fi
-fi
-
-# -- stage 2+: optional extras, added as the round builds them ------------
 for extra in "$REPO"/tools/battery.d/*.sh; do
   [ -e "$extra" ] || continue
   name="$(basename "$extra" .sh)"
@@ -72,7 +51,6 @@ done
 
 # DONE only when every known stage is complete.
 all=yes
-stage_done bench || all=no
 for extra in "$REPO"/tools/battery.d/*.sh; do
   [ -e "$extra" ] || continue
   stage_done "$(basename "$extra" .sh)" || all=no

@@ -16,7 +16,7 @@ reduction is an orthogonal pass either way). ``block_apply`` is the full
 differentiable primitive: Pallas forward + Pallas backward via
 ``jax.custom_vjp``, with the backward kernel recomputing the forward
 chain in VMEM from ``x`` alone — no residual tensors ever touch HBM.
-Battery stage 32 A/Bs both directions against XLA's compilation of the
+Battery stage 05_fused_block_ab A/Bs both directions against XLA's compilation of the
 identical math (`block_fwd_reference`) at CIFAR shapes on a live window.
 A win green-lights model integration (batch stats + strided/projection
 variants); a loss gets recorded next to the xent kernel's negative
