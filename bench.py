@@ -34,8 +34,10 @@ window it falls back to a small CPU measurement clearly labeled
     python bench.py --child cpu     # measurement child, reduced counts
 """
 
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -681,8 +683,58 @@ def _parse_result(out: str):
     return None
 
 
+def _cached_tpu_snapshot():
+    """Latest archived real-TPU bench artifact, for carrying chip truth
+    through a down tunnel (VERDICT r3 item 3: every official BENCH_r0N so
+    far was captured while the flapping tunnel was down, recording 0.01
+    st/s CPU fallbacks while fetch-verified TPU numbers sat in docs/runs/).
+    Scans ``docs/runs/bench_r*_tpu_v5e.json`` — artifacts archived by the
+    battery only after validating ``backend == "tpu" and not partial`` —
+    and returns the newest with explicit provenance. Clearly labeled: this
+    is NOT a measurement of the current run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    cands = []
+    for p in glob.glob(os.path.join(here, "docs", "runs",
+                                    "bench_r*_tpu_v5e.json")):
+        m = re.search(r"bench_r(\d+)_tpu_v5e\.json$", p)
+        if m:
+            cands.append((int(m.group(1)), p))
+    for rnd, p in sorted(cands, reverse=True):
+        try:
+            with open(p) as f:
+                snap = json.load(f)
+        except (ValueError, OSError):
+            continue
+        if snap.get("backend") != "tpu" or snap.get("partial"):
+            continue
+        try:
+            head = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, timeout=10).stdout.strip() or None
+        except Exception:
+            head = None
+        return {
+            "provenance": ("cached real-TPU measurement from an earlier "
+                           "live tunnel window; NOT measured in this run "
+                           "(chip unreachable — see tpu_error/error)"),
+            "source_file": os.path.relpath(p, here),
+            "archived_round": rnd,
+            "archived_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(p))),
+            "emitting_head": head,
+            "snapshot": snap,
+        }
+    return None
+
+
 def _emit(result: dict, cifar_sps, extra=None):
-    """Print the single driver-facing JSON line (headline = CIFAR)."""
+    """Print the single driver-facing JSON line (headline = CIFAR). Any
+    emit that is not a live-TPU measurement (CPU fallback, SIGTERM flush,
+    backend=none) additionally carries the newest archived real-TPU
+    artifact under ``cached_tpu_snapshot`` so a down tunnel degrades the
+    record to "last chip truth + today's failure diagnostics" instead of
+    an uncontextualized 0.01 st/s."""
     line = {
         "metric": HEADLINE_METRIC,
         "value": round(cifar_sps, 2) if cifar_sps else None,
@@ -693,6 +745,10 @@ def _emit(result: dict, cifar_sps, extra=None):
     line.update(result)
     if extra:
         line.update(extra)
+    if line.get("backend") != "tpu":
+        cached = _cached_tpu_snapshot()
+        if cached:
+            line["cached_tpu_snapshot"] = cached
     print(json.dumps(line), flush=True)
 
 

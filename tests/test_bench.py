@@ -139,3 +139,94 @@ def test_fetch_sync_returns_scalar():
 
     v = bench._fetch_sync(jnp.float32(3.5))
     assert isinstance(v, float) and v == 3.5
+
+
+# --- cached-TPU-snapshot carry (VERDICT r3 item 3) -----------------------
+# Every official BENCH_r0N so far was captured with the tunnel down; these
+# pin the degraded-mode contract: any non-TPU emit carries the newest
+# archived real-TPU artifact under an explicit, provenance-labeled key.
+
+def _newest_archived_tpu():
+    import glob
+    import json
+    import os
+    import re
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    best = None
+    for p in glob.glob(os.path.join(here, "docs", "runs",
+                                    "bench_r*_tpu_v5e.json")):
+        m = re.search(r"bench_r(\d+)_tpu_v5e\.json$", p)
+        if m and (best is None or int(m.group(1)) > best[0]):
+            best = (int(m.group(1)), p)
+    return best
+
+
+def test_cached_tpu_snapshot_picks_newest_archived_artifact():
+    import json
+    best = _newest_archived_tpu()
+    assert best is not None, "docs/runs should hold >=1 archived TPU bench"
+    cached = bench._cached_tpu_snapshot()
+    assert cached["archived_round"] == best[0]
+    assert cached["snapshot"] == json.load(open(best[1]))
+    assert cached["snapshot"]["backend"] == "tpu"
+    assert "NOT measured" in cached["provenance"]
+
+
+def test_emit_attaches_cache_only_on_non_tpu_backends(capsys):
+    import json
+    bench._emit({"backend": "cpu"}, 1.5)
+    line = json.loads(capsys.readouterr().out)
+    assert line["cached_tpu_snapshot"]["snapshot"]["backend"] == "tpu"
+    bench._emit({"backend": "tpu"}, 100.0)
+    line = json.loads(capsys.readouterr().out)
+    assert "cached_tpu_snapshot" not in line
+
+
+def test_down_tunnel_bench_emits_cached_snapshot():
+    """Simulated down tunnel end to end: scrubbed CPU env (probe sees cpu,
+    which the watcher rejects as 'down'), fallback disabled like the
+    battery does — the emitted line must still carry chip truth."""
+    import json
+    import subprocess
+    import sys
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(1)
+    env.update(BENCH_WATCH_WINDOW="1", BENCH_PROBE_TIMEOUT="60",
+               BENCH_CPU_FALLBACK="0", BENCH_TPU_ATTEMPTS="1")
+    proc = subprocess.run([sys.executable, "bench.py"], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True, timeout=300, cwd=bench.os.path.dirname(
+                              bench.os.path.abspath(bench.__file__)))
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 1          # honest: no live measurement
+    assert line["backend"] == "none"
+    best = _newest_archived_tpu()
+    assert line["cached_tpu_snapshot"]["snapshot"] == json.load(open(best[1]))
+    assert line["value"] is None          # headline stays a live-only field
+
+
+def test_sigterm_flush_carries_cached_snapshot():
+    """Driver SIGTERMs the watcher mid-window (the BENCH_r03 death mode):
+    the handler must flush one JSON line immediately, cache attached."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(1)
+    env.update(BENCH_WATCH_WINDOW="600", BENCH_PROBE_TIMEOUT="60",
+               BENCH_CPU_FALLBACK="0", BENCH_TPU_ATTEMPTS="1")
+    proc = subprocess.Popen([sys.executable, "bench.py"], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, cwd=bench.os.path.dirname(
+                                bench.os.path.abspath(bench.__file__)))
+    _time.sleep(10)                       # into the first poll sleep
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["backend"] == "none"
+    assert "SIGTERM" in line["error"]
+    assert line["cached_tpu_snapshot"]["snapshot"]["backend"] == "tpu"

@@ -47,7 +47,12 @@ python -m tpu_resnet plot --dir "$RUN" \
 python - "$DEST" <<'EOF'
 import json, sys, os
 dest = sys.argv[1]
-recs = [json.loads(l) for l in open(os.path.join(dest, "train_metrics.jsonl"))]
+recs = []
+for l in open(os.path.join(dest, "train_metrics.jsonl")):
+    try:  # a mid-write kill at a window close can leave a torn line
+        recs.append(json.loads(l))
+    except ValueError:
+        pass
 recs = [r for r in recs if "loss" in r]
 def win(lo, hi):
     xs = [r["loss"] for r in recs if lo <= r["step"] <= hi]
