@@ -75,12 +75,15 @@ def _peak_flops(device_kind: str):
 # --------------------------------------------------------------------------
 
 def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
-                       synthetic=False, width=None, num_classes=None):
+                       synthetic=False, width=None, num_classes=None,
+                       mutate_cfg=None):
     """Shared measurement scaffolding: resolved config + model + schedule
     + replicated initial state (one copy of what every measurement
     needs). ``None`` overrides keep the preset's values; ``synthetic``
     swaps the dataset for download-free data with the same class count
-    (unless ``num_classes`` overrides it)."""
+    (unless ``num_classes`` overrides it). ``mutate_cfg`` (cfg -> None)
+    applies arbitrary overrides after the named ones — the hook
+    tools/fused_model_ab.py uses to flip ``model.fused_blocks``."""
     import jax
     import jax.numpy as jnp
 
@@ -104,6 +107,8 @@ def _build_train_setup(mesh, preset, resnet_size, batch, dtype, image,
     if width is not None:
         cfg.model.width_multiplier = width
     cfg.model.compute_dtype = dtype
+    if mutate_cfg is not None:
+        mutate_cfg(cfg)
 
     model = build_model(cfg)
     sched = build_schedule(cfg.optim, cfg.train)
@@ -132,7 +137,7 @@ def _fetch_sync(x) -> float:
 
 def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
                    batch=128, dtype="bfloat16", split=50_000, width=None,
-                   num_classes=None):
+                   num_classes=None, mutate_cfg=None):
     """Resident-path CIFAR-shaped measurement over one shared setup; model
     and optimizer come from ``preset`` (overridable for smoke tests).
 
@@ -149,7 +154,8 @@ def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
 
     cfg, model, sched, state, rng = _build_train_setup(
         mesh, preset, resnet_size=resnet_size, batch=batch, dtype=dtype,
-        image=32, synthetic=True, width=width, num_classes=num_classes)
+        image=32, synthetic=True, width=width, num_classes=num_classes,
+        mutate_cfg=mutate_cfg)
 
     # CIFAR-sized synthetic split, resident in HBM like a real run.
     images, labels = cifar_data.synthetic_data(split, 32,

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Integrated fused-block A/B on the live chip: model.fused_blocks on/off
+# through the real headline path (after stage 05's kernel-level A/B and
+# the stage-10 bench — a fused-path failure here must not cost the
+# window's decisive artifacts). Two full-model compiles (~60-120 s each
+# first-cache) plus measurement.
+set -euo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+timeout -k 30 1800 python tools/fused_model_ab.py \
+  --out docs/runs/fused_model_ab_r4.json | tail -4

@@ -149,6 +149,16 @@ class ModelConfig:
     # O(depth)): enables batches past the HBM ceiling (e.g. b512 @224)
     # at ~33% block recompute cost. Off by default.
     remat: bool = False
+    # Hybrid fused-Pallas block dispatch (CIFAR basic-block nets only):
+    # stride-1 identity blocks run as single VMEM-resident Pallas kernels
+    # (models/resnet.py::FusedBuildingBlock), transition blocks stay XLA.
+    # Checkpoint-compatible with the XLA path (identical param tree).
+    # Default OFF pending battery stage 05_fused_block_ab's live A/B
+    # (docs/PERF.md "CIFAR is overhead-bound"); single-device validated.
+    fused_blocks: bool = False
+    # Forward batch tile of the fused kernels (backward tile derives from
+    # it); tunable from tools/fused_model_ab.py --batch-tile.
+    fused_block_tile: int = 16
     # MLP sanity model (reference logist_model.py:11) hidden units.
     mlp_hidden_units: int = 100
 

@@ -38,4 +38,6 @@ def build_model(cfg):
             remat=cfg.model.remat)
     return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
                            width_multiplier=cfg.model.width_multiplier,
-                           dtype=dtype, remat=cfg.model.remat)
+                           dtype=dtype, remat=cfg.model.remat,
+                           fused_blocks=cfg.model.fused_blocks,
+                           fused_block_tile=cfg.model.fused_block_tile)

@@ -161,6 +161,117 @@ class _StemKernel(nn.Module):
                           (7, 7, in_channels, self.filters), jnp.float32)
 
 
+class _BNVars(nn.Module):
+    """nn.BatchNorm's exact parameter/stat tree (params scale/bias,
+    batch_stats mean/var, same names, shapes, inits, fp32) for a BN whose
+    math runs inside the fused Pallas kernel instead of a flax layer."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        f = self.features
+        scale = self.param("scale", nn.initializers.ones_init(), (f,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (f,),
+                          jnp.float32)
+        mean = self.variable("batch_stats", "mean",
+                             lambda: jnp.zeros((f,), jnp.float32))
+        var = self.variable("batch_stats", "var",
+                            lambda: jnp.ones((f,), jnp.float32))
+        return scale, bias, mean, var
+
+
+class _BNSite(nn.Module):
+    """Wraps _BNVars one scope deeper (child name 'bn') so the tree path
+    matches BatchNormRelu's nn.BatchNorm exactly (e.g. preact/bn/scale)."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return _BNVars(self.features, name="bn")()
+
+
+class _ConvKernel(nn.Module):
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param("kernel", conv_kernel_init,
+                          (3, 3, self.in_features, self.features),
+                          jnp.float32)
+
+
+class _ConvSite(nn.Module):
+    """Wraps _ConvKernel at child name 'conv' — path matches
+    ConvFixedPadding's nn.Conv (e.g. conv1/conv/kernel)."""
+
+    features: int
+    in_features: int
+
+    @nn.compact
+    def __call__(self):
+        return _ConvKernel(self.features, self.in_features, name="conv")()
+
+
+class FusedBuildingBlock(nn.Module):
+    """BuildingBlock (stride 1, identity shortcut) executed as the fused
+    Pallas residual-block kernel family (tpu_resnet/ops/fused_block.py):
+    one VMEM-resident program per block — scale-bias, ReLU, two 3×3 convs,
+    residual add — instead of XLA's several sequential fused loops, built
+    to harvest the CIFAR step's measured ~3.7× overhead-above-roofline gap
+    (docs/PERF.md "CIFAR is overhead-bound").
+
+    The parameter/stat tree is IDENTICAL to BuildingBlock (same paths,
+    shapes, inits — asserted by tests/test_fused_model.py), so checkpoints
+    are interchangeable and ``model.fused_blocks`` can flip on a restore.
+
+    Training uses ``block_train_apply`` (live batch moments, custom-VJP
+    backward with full BN correction terms) and updates the running-stats
+    EMA exactly like nn.BatchNorm (momentum 0.997). Eval folds the running
+    stats to scale/bias and uses ``block_apply``.
+
+    BN-semantics caveat: batch moments are taken over the batch the kernel
+    sees. Single-device (the CIFAR headline config) that equals global
+    batch BN; under multi-device SPMD the Pallas stats pass has not been
+    validated against the sync-BN global-moments default — the gate is
+    for the measured single-chip path (battery stage 05_fused_block_ab).
+    """
+
+    filters: int
+    dtype: Dtype = jnp.float32
+    batch_tile: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        from tpu_resnet.ops import fused_block as fb
+
+        f = self.filters
+        gamma1, beta1, mean1, var1 = _BNSite(f, name="preact")()
+        w1 = _ConvSite(f, f, name="conv1")()
+        gamma2, beta2, mean2, var2 = _BNSite(f, name="bnrelu1")()
+        w2 = _ConvSite(f, f, name="conv2")()
+
+        if train:
+            y, (bm1, bv1, bm2, bv2) = fb.block_train_apply(
+                x, w1, w2, gamma1, beta1, gamma2, beta2,
+                _BATCH_NORM_EPSILON, self.batch_tile, None)
+            if not self.is_initializing():
+                m = _BATCH_NORM_MOMENTUM  # flax EMA convention
+                mean1.value = m * mean1.value + (1 - m) * bm1
+                var1.value = m * var1.value + (1 - m) * bv1
+                mean2.value = m * mean2.value + (1 - m) * bm2
+                var2.value = m * var2.value + (1 - m) * bv2
+            return y
+        s1, b1 = fb._fold(gamma1, beta1, mean1.value, var1.value,
+                          _BATCH_NORM_EPSILON)
+        s2, b2 = fb._fold(gamma2, beta2, mean2.value, var2.value,
+                          _BATCH_NORM_EPSILON)
+        return fb.block_apply(x, w1, w2, s1, b1, s2, b2, self.batch_tile)
+
+
 class BuildingBlock(nn.Module):
     """Basic 3×3+3×3 pre-activation block
     (reference resnet_model_official.py:94-130)."""
@@ -229,10 +340,16 @@ class BlockLayer(nn.Module):
     dtype: Dtype = jnp.float32
     bn_axis_name: Optional[str] = None
     remat: bool = False
+    # Fused Pallas kernel for the stride-1 identity blocks (hybrid
+    # dispatch: block0 — the strided/projection transition — always stays
+    # on the XLA path; see FusedBuildingBlock). Basic blocks only.
+    fused: bool = False
+    fused_tile: int = 16
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         block_cls = BottleneckBlock if self.bottleneck else BuildingBlock
+        fused_cls = FusedBuildingBlock
         if self.remat:
             # Rematerialize per block: activations are recomputed in the
             # backward pass instead of stored — trades ~33% more FLOPs in
@@ -241,11 +358,17 @@ class BlockLayer(nn.Module):
             # the usual ceiling). static_argnums: (self, x, train) — the
             # bool must stay a Python static.
             block_cls = nn.remat(block_cls, static_argnums=(2,))
+            fused_cls = nn.remat(fused_cls, static_argnums=(2,))
+        fuse = self.fused and not self.bottleneck
         x = block_cls(self.filters, self.strides, True, self.dtype,
                       self.bn_axis_name, name="block0")(x, train)
         for i in range(1, self.blocks):
-            x = block_cls(self.filters, 1, False, self.dtype,
-                          self.bn_axis_name, name=f"block{i}")(x, train)
+            if fuse:
+                x = fused_cls(self.filters, self.dtype, self.fused_tile,
+                              name=f"block{i}")(x, train)
+            else:
+                x = block_cls(self.filters, 1, False, self.dtype,
+                              self.bn_axis_name, name=f"block{i}")(x, train)
         return x
 
 
@@ -273,6 +396,11 @@ class ResNetV2(nn.Module):
     # batches that raise MXU utilization. Off by default — at b128/b256
     # the activations fit and remat only adds recompute FLOPs.
     remat: bool = False
+    # Hybrid fused-Pallas dispatch for stride-1 identity basic blocks
+    # (FusedBuildingBlock); transition blocks stay XLA. Off by default —
+    # gated on battery stage 05_fused_block_ab's A/B.
+    fused_blocks: bool = False
+    fused_block_tile: int = 16
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -296,6 +424,7 @@ class ResNetV2(nn.Module):
                                           self.stage_strides)):
             x = BlockLayer(f, b, s, self.bottleneck, self.dtype,
                            self.bn_axis_name, self.remat,
+                           self.fused_blocks, self.fused_block_tile,
                            name=f"block_layer{i + 1}")(x, train=train)
 
         x = BatchNormRelu(self.dtype, self.bn_axis_name, name="final_bnrelu")(
@@ -313,7 +442,9 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
                     width_multiplier: int = 1,
                     dtype: Dtype = jnp.bfloat16,
                     bn_axis_name: Optional[str] = None,
-                    remat: bool = False) -> ResNetV2:
+                    remat: bool = False,
+                    fused_blocks: bool = False,
+                    fused_block_tile: int = 16) -> ResNetV2:
     """6n+2 CIFAR ResNet-v2 (reference resnet_model_official.py:217-278).
 
     'ResNet-50' on CIFAR means n=8 basic blocks per stage with filters
@@ -341,6 +472,8 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
         dtype=dtype,
         bn_axis_name=bn_axis_name,
         remat=remat,
+        fused_blocks=fused_blocks,
+        fused_block_tile=fused_block_tile,
     )
 
 

@@ -25,8 +25,8 @@ result (docs/PERF.md) and this file stays an exemplar.
 Reference block semantics: v2 preactivation residual block,
 reference resnet_model_official.py:144-186 (building_block_v2).
 
-Training-path integration plan (round 4, contingent on the A/B): live
-batch stats fold into this design as a two-pass block. BN1's stats are
+Training-path integration (round 4: REALIZED, config-gated): live batch
+stats fold into this design as a two-pass block. BN1's stats are
 moments of the block input x (available before the kernel); BN2's are
 moments of conv1's output c1, which is produced inside the block — so
 pass A runs the tile grid accumulating c1's sum/sum-of-squares (c1 is
@@ -37,10 +37,15 @@ gains the standard BN batch-stats correction terms (dmean/dvar chain)
 in the same recompute style. Eval-path integration needs no new math:
 inference BN is exactly the folded scale/bias this kernel already takes
 (scale = gamma/sqrt(var+eps), bias = beta - gamma*mean/sqrt(var+eps)).
+The model-side dispatch is ``models/resnet.py::FusedBuildingBlock``
+behind ``model.fused_blocks`` (default off until the A/B), equivalence-
+tested against the XLA path in tests/test_fused_model.py; battery stage
+15_fused_model_ab measures it end to end on the headline config.
 """
 
 from __future__ import annotations
 
+import logging
 import functools
 
 
@@ -94,11 +99,22 @@ def _block_kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref,
 def _default_bwd_tile(batch: int, fwd_tile: int) -> int:
     """Largest divisor of ``batch`` that is <= fwd_tile // 2 (the backward
     kernels keep ~2-3x the forward's live set, and the tile must divide
-    the batch or _plumbing raises at jax.grad time)."""
+    the batch or _plumbing raises at jax.grad time).
+
+    A batch with no divisor near the target (e.g. a prime batch size)
+    silently degrades toward batch_tile=1 — a fully sequential per-example
+    backward grid, correct but very slow. That pathology must be visible
+    in unattended A/B logs (ADVICE r3), hence the warning."""
     target = max(1, min(batch, fwd_tile // 2))
-    while batch % target:
-        target -= 1
-    return target
+    chosen = target
+    while batch % chosen:
+        chosen -= 1
+    if chosen < max(1, target // 2):
+        logging.getLogger("tpu_resnet").warning(
+            "fused_block backward tile degraded to %d (target %d) for "
+            "batch %d — no divisor near fwd_tile//2; the backward grid is "
+            "near-sequential and will be slow", chosen, target, batch)
+    return chosen
 
 
 def _plumbing(x, batch_tile, interpret):
