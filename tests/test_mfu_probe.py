@@ -1,0 +1,37 @@
+"""tools/mfu_probe.py CPU smoke — battery stage 20_cifar_roofline runs the
+cifar10 preset path (uint8 inputs + augment_fn wiring, the --no-s2d/--image
+guard) unattended on a live TPU window as its FIRST production run; these
+keep that from being its first run ever (ADVICE r3), mirroring
+tests/test_streaming_gap_probe.py."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import mfu_probe  # noqa: E402
+
+
+def test_probe_cifar_tiny_config(tmp_path, monkeypatch):
+    out = tmp_path / "cost.json"
+    monkeypatch.setattr(sys, "argv", [
+        "mfu_probe.py", "--preset", "cifar10", "--resnet-size", "8",
+        "--batch", "16", "--steps", "2", "--out", str(out)])
+    mfu_probe.main()
+    got = json.load(open(out))
+    assert got["preset"] == "cifar10"
+    assert got["image"] == 32
+    assert got["steps_per_sec"] > 0
+    assert got["cost_flops_per_step_per_device"] >= 0
+
+
+def test_probe_cifar_rejects_imagenet_only_flags(monkeypatch):
+    for flag in (["--no-s2d"], ["--image", "64"]):
+        monkeypatch.setattr(sys, "argv", [
+            "mfu_probe.py", "--preset", "cifar10"] + flag)
+        with pytest.raises(SystemExit):
+            mfu_probe.main()

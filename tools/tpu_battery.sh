@@ -26,8 +26,8 @@ mark_done() { touch "$OUT/stage.$1.ok"; }
 # One core: pause any background CPU convergence runs (tagged conv_bn /
 # sched_ in their command lines) while TPU measurements are
 # timing-sensitive.
-pkill -STOP -f 'conv_bn|sched_' 2>/dev/null || true
-trap "pkill -CONT -f 'conv_bn|sched_' 2>/dev/null || true" EXIT
+pkill -STOP -f 'conv_bn|sched_|pytest' 2>/dev/null || true
+trap "pkill -CONT -f 'conv_bn|sched_|pytest' 2>/dev/null || true" EXIT
 
 # Re-probe between stages: if the tunnel died mid-battery, return to the
 # watcher's poll loop rather than hanging on the next stage.

@@ -27,12 +27,17 @@ def load_label_map(cfg: RunConfig, label_file: str = "") -> list:
     if label_file:
         names = {}
         with open(label_file) as f:
-            # imagenet1000_clsidx_to_labels.txt style: "idx: 'name',"
+            # imagenet1000_clsidx_to_labels.txt style: a python-dict-ish
+            # listing — "{0: 'name, synonym',\n ...\n 999: 'name'}". The
+            # first/last lines carry the braces inline, so both ends are
+            # stripped around the quotes (the final entry's name otherwise
+            # keeps a trailing "'}").
             for line in f:
                 line = line.strip().rstrip(",")
                 if ":" in line:
                     idx, name = line.split(":", 1)
-                    names[int(idx.strip(" {"))] = name.strip().strip("'\"")
+                    name = name.strip().rstrip("}").strip().strip("'\"")
+                    names[int(idx.strip(" {"))] = name
         return [names.get(i, str(i)) for i in range(cfg.data.num_classes)]
     if cfg.data.dataset == "cifar10":
         return CIFAR10_LABELS
