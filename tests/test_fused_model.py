@@ -146,3 +146,33 @@ def test_training_run_matches_xla_path(tmp_path):
 
     np.testing.assert_allclose(losses[True], losses[False],
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fused_composes_with_remat(setup):
+    """model.remat wraps FusedBuildingBlock too (nn.remat over a
+    custom-VJP pallas call) — the composition must produce the same
+    forward AND the same gradients as the plain fused model."""
+    _, fused_model, variables, x = setup
+    remat_model = cifar_resnet_v2(SIZE, num_classes=10, dtype=jnp.float32,
+                                  fused_blocks=True, remat=True)
+    y_plain = fused_model.apply(variables, x, train=False)
+    y_remat = remat_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_remat), np.asarray(y_plain),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_for(model):
+        def loss(params):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            return jnp.mean(logits ** 2)
+        return loss
+
+    g_remat = jax.grad(loss_for(remat_model))(variables["params"])
+    g_plain = jax.grad(loss_for(fused_model))(variables["params"])
+    flat_p = jax.tree_util.tree_leaves_with_path(g_plain)
+    flat_r = dict(jax.tree_util.tree_leaves_with_path(g_remat))
+    for path, leaf in flat_p:
+        np.testing.assert_allclose(
+            np.asarray(flat_r[path]), np.asarray(leaf),
+            rtol=1e-5, atol=1e-6, err_msg=jax.tree_util.keystr(path))
