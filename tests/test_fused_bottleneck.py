@@ -1,0 +1,94 @@
+"""Oracle tests for the halo-tiled fused bottleneck kernel
+(tpu_resnet/ops/fused_bottleneck.py) in interpret mode: forward against
+the XLA reference, backward against jax.grad of the reference — including
+the row-band boundaries where the halo masking must reproduce SAME-conv
+zero padding exactly. Battery stage 55 runs the live A/B unattended;
+these keep that from being its first execution ever."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resnet.ops import fused_bottleneck as fb
+
+F, C4 = 8, 32
+
+
+def _params(seed=0, f=F, c4=C4):
+    rng = np.random.default_rng(seed)
+    def a(*s):
+        return jnp.asarray(rng.normal(size=s, scale=0.3), jnp.float32)
+    return dict(w1=a(c4, f), w2=a(3, 3, f, f), w3=a(f, c4),
+                s1=a(c4) + 1.0, b1=a(c4), s2=a(f) + 1.0, b2=a(f),
+                s3=a(f) + 1.0, b3=a(f))
+
+
+def _x(b=4, h=8, w=8, c4=C4, seed=1):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(b, h, w, c4)), jnp.float32)
+
+
+@pytest.mark.parametrize("h,ht,bt", [(8, 4, 2),   # 2 row bands + halo
+                                     (8, 2, 1),   # 4 bands, heavy halo
+                                     (4, 4, 4)])  # single band (clamped)
+def test_forward_matches_reference(h, ht, bt):
+    p = _params()
+    x = _x(h=h, w=h)
+    y_ref = fb.bottleneck_fwd_reference(x, **p)
+    y = fb.bottleneck_fwd(x, *[p[k] for k in
+                               ("w1", "w2", "w3", "s1", "b1", "s2", "b2",
+                                "s3", "b3")],
+                          batch_tile=bt, row_tile=ht, interpret=True)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("h,ht,bt", [(8, 4, 2), (8, 2, 2), (4, 4, 4)])
+def test_gradients_match_reference(h, ht, bt):
+    p = _params()
+    x = _x(h=h, w=h)
+    keys = ("w1", "w2", "w3", "s1", "b1", "s2", "b2", "s3", "b3")
+
+    def loss_ref(x, p):
+        y = fb.bottleneck_fwd_reference(x, **p)
+        return jnp.sum(jnp.sin(y))
+
+    def loss_fused(x, p):
+        y = fb.bottleneck_apply(x, *[p[k] for k in keys], bt, ht, True)
+        return jnp.sum(jnp.sin(y))
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1))(x, p)
+    g_fused = jax.grad(loss_fused, argnums=(0, 1))(x, p)
+    np.testing.assert_allclose(np.asarray(g_fused[0]),
+                               np.asarray(g_ref[0]), rtol=1e-4, atol=1e-4)
+    for k in keys:
+        np.testing.assert_allclose(
+            np.asarray(g_fused[1][k]), np.asarray(g_ref[1][k]),
+            rtol=1e-4, atol=1e-4, err_msg=k)
+
+
+def test_bf16_io_dtype_preserved():
+    p = _params()
+    x = _x().astype(jnp.bfloat16)
+    keys = ("w1", "w2", "w3", "s1", "b1", "s2", "b2", "s3", "b3")
+    y = fb.bottleneck_fwd(x, *[p[k] for k in keys], batch_tile=2,
+                          row_tile=4, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    y_ref = fb.bottleneck_fwd_reference(x, **p)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(y_ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_tile_plan_validation():
+    p = _params()
+    keys = ("w1", "w2", "w3", "s1", "b1", "s2", "b2", "s3", "b3")
+    with pytest.raises(ValueError, match="even"):
+        fb.bottleneck_fwd(_x(h=6, w=6), *[p[k] for k in keys],
+                          batch_tile=2, row_tile=3, interpret=True)
+    with pytest.raises(ValueError, match="divisible"):
+        fb.bottleneck_fwd(_x(h=8, w=8), *[p[k] for k in keys],
+                          batch_tile=3, row_tile=4, interpret=True)
+    with pytest.raises(ValueError, match="tile plan"):
+        fb.bottleneck_fwd(_x(h=8, w=8), *[p[k] for k in keys])

@@ -1,0 +1,26 @@
+"""tools/fused_bottleneck_ab.py CPU smoke (tiny shapes, interpret-mode
+kernels) — battery stage 55 runs unattended on a live window; this keeps
+that from being its first execution ever."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import fused_bottleneck_ab  # noqa: E602,E402
+
+
+def test_ab_tiny_config(tmp_path, monkeypatch):
+    out = tmp_path / "ab.json"
+    monkeypatch.setattr(sys, "argv", [
+        "fused_bottleneck_ab.py", "--shapes", "4,8,8", "--length", "2",
+        "--reps", "1", "--batch-tile", "2", "--row-tile", "4",
+        "--dtype", "float32", "--out", str(out)])
+    fused_bottleneck_ab.main()
+    got = json.load(open(out))
+    (key, entry), = got["by_shape"].items()
+    assert "error" not in entry, entry
+    assert entry["fwd"]["pallas_us_per_block"] > 0
+    assert entry["fwd_bwd"]["xla_us_per_block"] > 0

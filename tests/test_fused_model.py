@@ -176,3 +176,15 @@ def test_fused_composes_with_remat(setup):
         np.testing.assert_allclose(
             np.asarray(flat_r[path]), np.asarray(leaf),
             rtol=1e-5, atol=1e-6, err_msg=jax.tree_util.keystr(path))
+
+
+def test_fused_blocks_rejected_for_imagenet():
+    """model.fused_blocks on the ImageNet generator must fail loudly, not
+    silently run the XLA path (the conflicting-override convention)."""
+    from tpu_resnet.config import load_config
+    from tpu_resnet.models import build_model
+
+    cfg = load_config("imagenet")
+    cfg.model.fused_blocks = True
+    with pytest.raises(ValueError, match="fused_blocks"):
+        build_model(cfg)

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Halo-tiled fused ImageNet bottleneck A/B (VERDICT r3 item 4) — GATED on
+# the basic-block kernel A/B having proven block fusion on this chip: if
+# stage 05's artifact shows no direction with speedup > 1, skip (exit 0,
+# stage marked done) per "on a loss, stop investing in Pallas block
+# fusion". Runs after the decisive stages and the headline bench.
+set -uo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+GATE="docs/runs/fused_block_ab_r4.json"
+if [ ! -f "$GATE" ]; then
+  echo "[fused_bottleneck_ab] gate artifact $GATE missing (stage 05 not run?) — skipping"
+  exit 0
+fi
+if ! python - "$GATE" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+wins = [d.get("speedup", 0) > 1.0
+        for shape in r.get("by_shape", {}).values()
+        for name, d in shape.items() if isinstance(d, dict)]
+sys.exit(0 if any(wins) else 1)
+EOF
+then
+  echo "[fused_bottleneck_ab] basic-block A/B shows no winning direction — skipping (negative result stands)"
+  exit 0
+fi
+
+# 2 arms x 2 directions x 3 shapes; compiles dominate first-cache runs.
+timeout -k 30 1800 python tools/fused_bottleneck_ab.py \
+  --out docs/runs/fused_bottleneck_ab_r4.json | tail -6

@@ -32,6 +32,15 @@ def build_model(cfg):
     if cfg.model.name != "resnet":
         raise ValueError(f"unknown model {cfg.model.name!r}")
     if cfg.data.dataset == "imagenet":
+        if cfg.model.fused_blocks:
+            # Fail loudly rather than silently run the XLA path (the
+            # bench conflicting-override convention): the fused kernels
+            # cover the CIFAR generator's stride-1 identity basic blocks;
+            # the ImageNet bottleneck analog is a separate halo-tiled
+            # kernel gated on the stage-05 A/B (docs/PERF.md).
+            raise ValueError("model.fused_blocks is not supported by the "
+                             "ImageNet generator (CIFAR basic-block nets "
+                             "only)")
         return imagenet_resnet_v2(
             cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
             stem_space_to_depth=cfg.model.stem_space_to_depth,
