@@ -3,7 +3,9 @@
 # the basic-block kernel A/B having proven block fusion on this chip: if
 # stage 05's artifact shows no direction with speedup > 1, skip (exit 0,
 # stage marked done) per "on a loss, stop investing in Pallas block
-# fusion". Runs after the decisive stages and the headline bench.
+# fusion". A gate PARSE error is NOT a negative result: it fails the
+# stage so the battery retries next window instead of silently marking
+# a crashed evaluation as a standing loss.
 set -uo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 cd "$REPO"
@@ -13,17 +15,28 @@ if [ ! -f "$GATE" ]; then
   echo "[fused_bottleneck_ab] gate artifact $GATE missing (stage 05 not run?) — skipping"
   exit 0
 fi
-if ! python - "$GATE" <<'EOF'
+python - "$GATE" <<'EOF'
 import json, sys
-r = json.load(open(sys.argv[1]))
-wins = [d.get("speedup", 0) > 1.0
-        for shape in r.get("by_shape", {}).values()
-        for name, d in shape.items() if isinstance(d, dict)]
+try:
+    r = json.load(open(sys.argv[1]))
+    wins = [d.get("speedup", 0) > 1.0
+            for shape in r.get("by_shape", {}).values()
+            for name, d in shape.items() if isinstance(d, dict)]
+except Exception as e:  # torn/invalid artifact: infra error, not a loss
+    print(f"[fused_bottleneck_ab] gate artifact unreadable: {e}")
+    sys.exit(2)
+if not wins:
+    print("[fused_bottleneck_ab] gate artifact has no measured directions")
+    sys.exit(2)
 sys.exit(0 if any(wins) else 1)
 EOF
-then
+rc=$?
+if [ $rc -eq 1 ]; then
   echo "[fused_bottleneck_ab] basic-block A/B shows no winning direction — skipping (negative result stands)"
   exit 0
+elif [ $rc -eq 2 ]; then
+  echo "[fused_bottleneck_ab] gate evaluation failed — stage will retry next window"
+  exit 1
 fi
 
 # 2 arms x 2 directions x 3 shapes; compiles dominate first-cache runs.

@@ -235,9 +235,13 @@ class FusedBuildingBlock(nn.Module):
 
     BN-semantics caveat: batch moments are taken over the batch the kernel
     sees. Single-device (the CIFAR headline config) that equals global
-    batch BN; under multi-device SPMD the Pallas stats pass has not been
-    validated against the sync-BN global-moments default — the gate is
-    for the measured single-chip path (battery stage 05_fused_block_ab).
+    batch BN. On the virtual 8-device mesh the fused path reproduces the
+    sync-BN XLA losses under auto-sharding (measured to 7e-7,
+    tests/test_fused_model.py::test_fused_matches_xla_on_8device_mesh) —
+    but there the interpret-mode kernels lower to ordinary XLA ops;
+    real-TPU multi-chip auto-sharding of the non-interpret Pallas custom
+    call remains unvalidated. The gate is for the measured single-chip
+    path (battery stages 05/15).
     """
 
     filters: int

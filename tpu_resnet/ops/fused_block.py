@@ -256,7 +256,7 @@ def _block_bwd_kernel(x_ref, gy_ref, w1_ref, w2_ref, s1_ref, b1_ref,
     ds2 = jnp.sum(da2 * c1, axis=(0, 1, 2))
     db2 = jnp.sum(da2, axis=(0, 1, 2))
 
-    _acc_out(i, (dw1_ref, dw2_ref, ds1_ref, db1_ref, ds2_ref, db2_ref),
+    _acc_out(i == 0, (dw1_ref, dw2_ref, ds1_ref, db1_ref, ds2_ref, db2_ref),
              (dw1, dw2, ds1, db1, ds2, db2))
 
 
@@ -321,13 +321,16 @@ def _recompute_train(x, w1, g1, b1, g2, b2, m1, i1, m2, i2,
     return z1, z1hat, r1p, z2, z2hat, r2p
 
 
-def _acc_out(i, refs, vals):
-    @pl.when(i == 0)
+def _acc_out(first, refs, vals):
+    """Init-or-accumulate outputs across a sequential grid; ``first`` is
+    the predicate marking the first grid step (a bool so 2-D grids — the
+    bottleneck kernels — can use it too)."""
+    @pl.when(first)
     def _init():
         for ref, v in zip(refs, vals):
             ref[...] = v
 
-    @pl.when(i > 0)
+    @pl.when(jnp.logical_not(first))
     def _acc():
         for ref, v in zip(refs, vals):
             ref[...] += v
@@ -366,7 +369,7 @@ def _train_bwd_calls(x, gy, w1, w2, g1, b1, g2, b2, moments, eps, *,
         gyp = jnp.pad(gyv, ((0, 0), (1, 1), (1, 1), (0, 0)))
         dr2 = _conv3x3_taps(gyp, _transpose_weights(w2v), bt, h, wdt, c)
         dz2 = jnp.where(z2 > 0, dr2, 0.0)
-        _acc_out(pl.program_id(0), (t1_ref, t2_ref, dw2_ref),
+        _acc_out(pl.program_id(0) == 0, (t1_ref, t2_ref, dw2_ref),
                  (jnp.sum(dz2, axis=(0, 1, 2)),
                   jnp.sum(dz2 * z2hat, axis=(0, 1, 2)),
                   _wgrad_taps(r2p, gyv, bt, h, wdt, c)))
@@ -397,7 +400,7 @@ def _train_bwd_calls(x, gy, w1, w2, g1, b1, g2, b2, moments, eps, *,
             jnp.pad(dc1, ((0, 0), (1, 1), (1, 1), (0, 0))),
             _transpose_weights(w1v), bt, h, wdt, c)
         dz1 = jnp.where(z1 > 0, dr1, 0.0)
-        _acc_out(pl.program_id(0), (u1_ref, u2_ref, dw1_ref),
+        _acc_out(pl.program_id(0) == 0, (u1_ref, u2_ref, dw1_ref),
                  (jnp.sum(dz1, axis=(0, 1, 2)),
                   jnp.sum(dz1 * z1hat, axis=(0, 1, 2)),
                   _wgrad_taps(r1p, dc1, bt, h, wdt, c)))
@@ -493,7 +496,7 @@ def _stats_kernel(x_ref, w1_ref, s1_ref, b1_ref, sum_ref, sumsq_ref):
     pre1 = jnp.pad(pre1, ((0, 0), (1, 1), (1, 1), (0, 0)))
     c1 = _conv3x3_taps(pre1, w1_ref[...].astype(jnp.float32),
                        bt, h, wdt, c)
-    _acc_out(i, (sum_ref, sumsq_ref),
+    _acc_out(i == 0, (sum_ref, sumsq_ref),
              (jnp.sum(c1, axis=(0, 1, 2)),
               jnp.sum(c1 * c1, axis=(0, 1, 2))))
 

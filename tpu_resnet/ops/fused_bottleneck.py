@@ -43,8 +43,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from tpu_resnet.ops.fused_block import (_conv3x3_taps, _transpose_weights,
-                                        _wgrad_taps, is_tpu_backend)
+from tpu_resnet.ops.fused_block import (_acc_out, _conv3x3_taps,
+                                        _transpose_weights, _wgrad_taps,
+                                        is_tpu_backend)
 
 try:  # TPU-only module; absent on pure-CPU installs of older jaxlibs
     from jax.experimental.pallas import tpu as pltpu
@@ -78,19 +79,6 @@ def _tiles_for(f: int, b: int, h: int, batch_tile=None, row_tile=None):
         # misalign them.
         raise ValueError(f"row_tile must be even, got {ht}")
     return bt, ht
-
-
-def _acc2(first, refs, vals):
-    """Accumulate weight-grad outputs across a sequential 2-D grid."""
-    @pl.when(first)
-    def _init():
-        for ref, v in zip(refs, vals):
-            ref[...] = v
-
-    @pl.when(jnp.logical_not(first))
-    def _acc():
-        for ref, v in zip(refs, vals):
-            ref[...] += v
 
 
 def _row_mask(rows, lo, hi, x):
@@ -323,7 +311,7 @@ def _bwd_kernel(height, x_c_ref, x_t_ref, x_b_ref, gy_c_ref, gy_t_ref,
     ds3 = jnp.sum(dm3_c * mid_c, axis=(0, 1, 2))
     db3 = jnp.sum(dm3_c, axis=(0, 1, 2))
 
-    _acc2((bi == 0) & (hi == 0),
+    _acc_out((bi == 0) & (hi == 0),
           (dw1_ref, dw2_ref, dw3_ref, ds1_ref, db1_ref, ds2_ref, db2_ref,
            ds3_ref, db3_ref),
           (dw1, dw2, dw3, ds1, db1, ds2, db2, ds3, db3))
