@@ -22,5 +22,7 @@ def test_ab_tiny_config(tmp_path, monkeypatch):
     got = json.load(open(out))
     (key, entry), = got["by_shape"].items()
     assert "error" not in entry, entry
-    assert entry["fwd"]["pallas_us_per_block"] > 0
-    assert entry["fwd_bwd"]["xla_us_per_block"] > 0
+    for arm in ("fwd", "fwd_bwd", "train_fwd_live_bn",
+                "train_fwd_bwd_live_bn"):
+        assert entry[arm]["pallas_us_per_block"] > 0, arm
+        assert entry[arm]["xla_us_per_block"] > 0, arm

@@ -229,3 +229,13 @@ def test_fused_matches_xla_on_8device_mesh():
         losses[fused] = run
     np.testing.assert_allclose(losses[True], losses[False],
                                rtol=2e-5, atol=2e-5)
+
+
+def test_fused_blocks_rejected_for_wide_resnet():
+    from tpu_resnet.config import load_config
+    from tpu_resnet.models import build_model
+
+    cfg = load_config("wrn28_10_cifar100")
+    cfg.model.fused_blocks = True
+    with pytest.raises(ValueError, match="width_multiplier"):
+        build_model(cfg)

@@ -39,6 +39,7 @@ elif [ $rc -eq 2 ]; then
   exit 1
 fi
 
-# 2 arms x 2 directions x 3 shapes; compiles dominate first-cache runs.
-timeout -k 30 1800 python tools/fused_bottleneck_ab.py \
+# 2 arms x 4 directions x 3 shapes (24 scan-program compiles); compiles
+# dominate first-cache runs.
+timeout -k 30 2700 python tools/fused_bottleneck_ab.py \
   --out docs/runs/fused_bottleneck_ab_r4.json | tail -6

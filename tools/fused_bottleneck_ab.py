@@ -57,9 +57,10 @@ def main():
     import numpy as np
 
     import bench
-    from tpu_resnet.ops.fused_bottleneck import (bottleneck_apply,
-                                                 bottleneck_fwd,
-                                                 bottleneck_fwd_reference)
+    from tpu_resnet.ops.fused_bottleneck import (
+        bottleneck_apply, bottleneck_fwd, bottleneck_fwd_reference,
+        bottleneck_train_apply, bottleneck_train_fwd,
+        bottleneck_train_fwd_reference)
 
     shapes = SHAPES
     if args.shapes:
@@ -143,6 +144,36 @@ def main():
                 "pallas_us_per_block": round(pallas_g_us, 2),
                 "xla_us_per_block": round(xla_g_us, 2),
                 "speedup": round(xla_g_us / pallas_g_us, 3)}
+            flush()
+
+            # Training direction with LIVE batch stats (staged stats
+            # passes + folded apply; four-pass correction backward) —
+            # the numbers that would decide model integration. The live
+            # blocks return (y, moments); dropping the moments ([0])
+            # reuses the folded-arm harnesses, and the folded arm's
+            # identity scale/bias double as raw BN gamma/beta here.
+            pallas_t_us = time_arm(chained(
+                lambda x, *p: bottleneck_train_fwd(
+                    x, *p, batch_tile=args.batch_tile,
+                    row_tile=args.row_tile)[0]))
+            xla_t_us = time_arm(chained(
+                lambda x, *p: bottleneck_train_fwd_reference(x, *p)[0]))
+            entry["train_fwd_live_bn"] = {
+                "pallas_us_per_block": round(pallas_t_us, 2),
+                "xla_us_per_block": round(xla_t_us, 2),
+                "speedup": round(xla_t_us / pallas_t_us, 3)}
+            flush()
+
+            pallas_tg_us = time_arm(chained_grad(
+                lambda x, *p: bottleneck_train_apply(
+                    x, *p, 1e-5, args.batch_tile, args.row_tile,
+                    None)[0]))
+            xla_tg_us = time_arm(chained_grad(
+                lambda x, *p: bottleneck_train_fwd_reference(x, *p)[0]))
+            entry["train_fwd_bwd_live_bn"] = {
+                "pallas_us_per_block": round(pallas_tg_us, 2),
+                "xla_us_per_block": round(xla_tg_us, 2),
+                "speedup": round(xla_tg_us / pallas_tg_us, 3)}
         except Exception as e:  # record and keep measuring other shapes
             out["by_shape"].setdefault(key, {})["error"] = (
                 f"{type(e).__name__}: {e}"[:500])

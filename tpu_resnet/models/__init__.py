@@ -45,6 +45,12 @@ def build_model(cfg):
             cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
             stem_space_to_depth=cfg.model.stem_space_to_depth,
             remat=cfg.model.remat)
+    if cfg.model.fused_blocks and cfg.model.width_multiplier > 1:
+        # Wide-ResNet channels (160/320/640 at WRN-28-10) put the default
+        # tile far past core VMEM, and no A/B has measured those shapes —
+        # fail loudly rather than ship an untested kernel configuration.
+        raise ValueError("model.fused_blocks is only measured/tiled for "
+                         "width_multiplier=1 (16/32/64-channel stages)")
     return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
                            width_multiplier=cfg.model.width_multiplier,
                            dtype=dtype, remat=cfg.model.remat,
