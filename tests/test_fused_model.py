@@ -178,16 +178,22 @@ def test_fused_composes_with_remat(setup):
             rtol=1e-5, atol=1e-6, err_msg=jax.tree_util.keystr(path))
 
 
-def test_fused_blocks_rejected_for_imagenet():
-    """model.fused_blocks on the ImageNet generator must fail loudly, not
-    silently run the XLA path (the conflicting-override convention)."""
+def test_fused_blocks_rejected_for_imagenet_basic_nets():
+    """model.fused_blocks on ImageNet ResNet-18/34 (basic blocks at
+    ImageNet shapes, no sized tile plan) must fail loudly; bottleneck
+    sizes dispatch to FusedBottleneckBlock."""
     from tpu_resnet.config import load_config
     from tpu_resnet.models import build_model
+    from tpu_resnet.models.resnet import ResNetV2
 
     cfg = load_config("imagenet")
     cfg.model.fused_blocks = True
-    with pytest.raises(ValueError, match="fused_blocks"):
+    cfg.model.resnet_size = 18
+    with pytest.raises(ValueError, match="18/34"):
         build_model(cfg)
+    cfg.model.resnet_size = 50
+    model = build_model(cfg)
+    assert isinstance(model, ResNetV2) and model.fused_blocks
 
 
 def test_fused_matches_xla_on_8device_mesh():
@@ -239,3 +245,102 @@ def test_fused_blocks_rejected_for_wide_resnet():
     cfg.model.fused_blocks = True
     with pytest.raises(ValueError, match="width_multiplier"):
         build_model(cfg)
+
+
+# --- FusedBottleneckBlock (ImageNet generator) ---------------------------
+
+BF = 64                      # smallest width with a default tile plan
+
+
+def _bottleneck_pair():
+    from tpu_resnet.models.resnet import (BottleneckBlock,
+                                          FusedBottleneckBlock)
+    xla = BottleneckBlock(BF, 1, False, jnp.float32)
+    fused = FusedBottleneckBlock(BF, jnp.float32)
+    return xla, fused
+
+
+@pytest.fixture(scope="module")
+def bsetup():
+    xla, fused = _bottleneck_pair()
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 8, 8, 4 * BF)), jnp.float32)
+    variables = xla.init(jax.random.PRNGKey(0), x, True)
+    return xla, fused, variables, x
+
+
+def test_bottleneck_param_tree_identical(bsetup):
+    xla, fused, variables, x = bsetup
+    fused_vars = fused.init(jax.random.PRNGKey(0), x, True)
+    assert (jax.tree.map(lambda a: (a.shape, a.dtype), variables)
+            == jax.tree.map(lambda a: (a.shape, a.dtype), fused_vars))
+
+
+def test_bottleneck_eval_forward_equivalence(bsetup):
+    xla, fused, variables, x = bsetup
+    y_xla = xla.apply(variables, x, False)
+    y_fused = fused.apply(variables, x, False)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_xla),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bottleneck_train_forward_stats_and_grads(bsetup):
+    xla, fused, variables, x = bsetup
+    y_xla, upd_xla = xla.apply(variables, x, True,
+                               mutable=["batch_stats"])
+    y_fused, upd_fused = fused.apply(variables, x, True,
+                                     mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_xla),
+                               rtol=1e-4, atol=1e-4)
+    flat_x = jax.tree_util.tree_leaves_with_path(upd_xla)
+    flat_f = dict(jax.tree_util.tree_leaves_with_path(upd_fused))
+    for path, leaf in flat_x:
+        np.testing.assert_allclose(
+            np.asarray(flat_f[path]), np.asarray(leaf),
+            rtol=1e-4, atol=1e-5, err_msg=jax.tree_util.keystr(path))
+
+    def loss_for(model):
+        def loss(params):
+            y, _ = model.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, True, mutable=["batch_stats"])
+            return jnp.mean(y ** 2)
+        return loss
+
+    g_xla = jax.grad(loss_for(xla))(variables["params"])
+    g_fused = jax.grad(loss_for(fused))(variables["params"])
+    flat_x = jax.tree_util.tree_leaves_with_path(g_xla)
+    flat_f = dict(jax.tree_util.tree_leaves_with_path(g_fused))
+    for path, leaf in flat_x:
+        np.testing.assert_allclose(
+            np.asarray(flat_f[path]), np.asarray(leaf),
+            rtol=5e-3, atol=1e-5, err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.slow
+def test_imagenet_rn50_fused_model_forward():
+    """Whole-model dispatch: rn50 at 64-pixel inputs (stages 16/8/4/2 —
+    the f=512 stage stays XLA by width policy) matches the XLA model in
+    both modes with shared variables. 64², batch 4 keeps every train-mode
+    BN normalizing over >=16 elements: at 32² the f=512 stage runs 1×1
+    spatial and its 2-element batch variance is near-singular, amplifying
+    the fused stages' benign 1e-6 diffs past any tolerance."""
+    from tpu_resnet.models.resnet import imagenet_resnet_v2
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(4, 64, 64, 3)), jnp.float32)
+    xla_model = imagenet_resnet_v2(50, 100, dtype=jnp.float32)
+    fused_model = imagenet_resnet_v2(50, 100, dtype=jnp.float32,
+                                     fused_blocks=True)
+    variables = xla_model.init(jax.random.PRNGKey(0), x, train=True)
+    y_xla = xla_model.apply(variables, x, train=False)
+    y_fused = fused_model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_xla),
+                               rtol=1e-4, atol=1e-4)
+    t_xla, _ = xla_model.apply(variables, x, train=True,
+                               mutable=["batch_stats"])
+    t_fused, _ = fused_model.apply(variables, x, train=True,
+                                   mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(t_fused), np.asarray(t_xla),
+                               rtol=1e-3, atol=1e-3)

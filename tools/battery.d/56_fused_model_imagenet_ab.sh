@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# ImageNet end-to-end fused-bottleneck model A/B: model.fused_blocks
+# on/off through the real ImageNet train step (FusedBottleneckBlock
+# dispatch) — GATED on stage 55's kernel-level A/B showing a winning
+# direction. Same error discipline as stage 55: a torn gate artifact
+# fails the stage (retry), a genuine loss skips it (done).
+set -uo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+
+GATE="docs/runs/fused_bottleneck_ab_r4.json"
+if [ ! -f "$GATE" ]; then
+  echo "[fused_model_imagenet_ab] gate artifact $GATE missing (stage 55 skipped or unrun) — skipping"
+  exit 0
+fi
+python - "$GATE" <<'EOF'
+import json, sys
+try:
+    r = json.load(open(sys.argv[1]))
+    wins = [d.get("speedup", 0) > 1.0
+            for shape in r.get("by_shape", {}).values()
+            for name, d in shape.items() if isinstance(d, dict)]
+except Exception as e:
+    print(f"[fused_model_imagenet_ab] gate artifact unreadable: {e}")
+    sys.exit(2)
+if not wins:
+    print("[fused_model_imagenet_ab] gate artifact has no measured directions")
+    sys.exit(2)
+sys.exit(0 if any(wins) else 1)
+EOF
+rc=$?
+if [ $rc -eq 1 ]; then
+  echo "[fused_model_imagenet_ab] bottleneck kernel A/B shows no winning direction — skipping (negative result stands)"
+  exit 0
+elif [ $rc -eq 2 ]; then
+  echo "[fused_model_imagenet_ab] gate evaluation failed — stage will retry next window"
+  exit 1
+fi
+
+timeout -k 30 1800 python tools/fused_model_ab.py --preset imagenet \
+  --out docs/runs/fused_model_imagenet_ab_r4.json | tail -4

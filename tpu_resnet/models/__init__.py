@@ -32,19 +32,20 @@ def build_model(cfg):
     if cfg.model.name != "resnet":
         raise ValueError(f"unknown model {cfg.model.name!r}")
     if cfg.data.dataset == "imagenet":
-        if cfg.model.fused_blocks:
+        if cfg.model.fused_blocks and cfg.model.resnet_size in (18, 34):
             # Fail loudly rather than silently run the XLA path (the
-            # bench conflicting-override convention): the fused kernels
-            # cover the CIFAR generator's stride-1 identity basic blocks;
-            # the ImageNet bottleneck analog is a separate halo-tiled
-            # kernel gated on the stage-05 A/B (docs/PERF.md).
-            raise ValueError("model.fused_blocks is not supported by the "
-                             "ImageNet generator (CIFAR basic-block nets "
-                             "only)")
+            # bench conflicting-override convention): the ImageNet
+            # basic-block nets put BuildingBlocks at 56²-scale shapes no
+            # fused tile plan has been sized or measured for. Bottleneck
+            # sizes dispatch to the halo-tiled kernel family
+            # (FusedBottleneckBlock; f=512 blocks stay XLA).
+            raise ValueError("model.fused_blocks is not supported for "
+                             "ImageNet ResNet-18/34 (basic blocks at "
+                             "ImageNet shapes); use a bottleneck size")
         return imagenet_resnet_v2(
             cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
             stem_space_to_depth=cfg.model.stem_space_to_depth,
-            remat=cfg.model.remat)
+            remat=cfg.model.remat, fused_blocks=cfg.model.fused_blocks)
     if cfg.model.fused_blocks and cfg.model.width_multiplier > 1:
         # Wide-ResNet channels (160/320/640 at WRN-28-10) put the default
         # tile far past core VMEM, and no A/B has measured those shapes —

@@ -196,11 +196,13 @@ class _BNSite(nn.Module):
 class _ConvKernel(nn.Module):
     features: int
     in_features: int
+    kernel_size: int = 3
 
     @nn.compact
     def __call__(self):
+        k = self.kernel_size
         return self.param("kernel", conv_kernel_init,
-                          (3, 3, self.in_features, self.features),
+                          (k, k, self.in_features, self.features),
                           jnp.float32)
 
 
@@ -210,10 +212,12 @@ class _ConvSite(nn.Module):
 
     features: int
     in_features: int
+    kernel_size: int = 3
 
     @nn.compact
     def __call__(self):
-        return _ConvKernel(self.features, self.in_features, name="conv")()
+        return _ConvKernel(self.features, self.in_features,
+                           self.kernel_size, name="conv")()
 
 
 class FusedBuildingBlock(nn.Module):
@@ -274,6 +278,71 @@ class FusedBuildingBlock(nn.Module):
         s2, b2 = fb._fold(gamma2, beta2, mean2.value, var2.value,
                           _BATCH_NORM_EPSILON)
         return fb.block_apply(x, w1, w2, s1, b1, s2, b2, self.batch_tile)
+
+
+# Bottleneck widths whose fused-kernel tile plans are sized for core
+# VMEM (ops/fused_bottleneck.py::_DEFAULT_TILES); f=512 blocks stay XLA.
+_FUSED_BOTTLENECK_WIDTHS = frozenset((64, 128, 256))
+
+
+class FusedBottleneckBlock(nn.Module):
+    """BottleneckBlock (stride 1, identity shortcut) executed as the
+    halo-tiled fused Pallas bottleneck kernel family
+    (tpu_resnet/ops/fused_bottleneck.py) — the ImageNet analog of
+    FusedBuildingBlock, built to cut the block-internal HBM traffic that
+    parks ImageNet MFU at the ~37% roofline (docs/PERF.md).
+
+    Parameter/stat tree is IDENTICAL to BottleneckBlock (asserted by
+    tests/test_fused_model.py), so checkpoints interchange. Training uses
+    ``bottleneck_train_apply`` (live batch moments for all three BNs,
+    four-pass correction backward) with the flax EMA; eval folds running
+    stats into ``bottleneck_apply``. Same BN-semantics caveat as
+    FusedBuildingBlock (single-device is the measured path; battery
+    stage 55 is the gate).
+    """
+
+    filters: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        import jax
+
+        from tpu_resnet.ops import fused_bottleneck as fbn
+
+        f = self.filters
+        c4 = 4 * f
+        g1, be1, mean1, var1 = _BNSite(c4, name="preact")()
+        w1 = _ConvSite(f, c4, 1, name="conv1")()
+        g2, be2, mean2, var2 = _BNSite(f, name="bnrelu1")()
+        w2 = _ConvSite(f, f, 3, name="conv2")()
+        g3, be3, mean3, var3 = _BNSite(f, name="bnrelu2")()
+        w3 = _ConvSite(c4, f, 1, name="conv3")()
+        w1m, w3m = w1[0, 0], w3[0, 0]   # 1×1 kernels as matrices
+
+        if train:
+            y, (bm1, bv1, bm2, bv2, bm3, bv3) = fbn.bottleneck_train_apply(
+                x, w1m, w2, w3m, g1, be1, g2, be2, g3, be3,
+                _BATCH_NORM_EPSILON)
+            if not self.is_initializing():
+                m = _BATCH_NORM_MOMENTUM  # flax EMA convention
+                for ra_m, ra_v, bm, bv in ((mean1, var1, bm1, bv1),
+                                           (mean2, var2, bm2, bv2),
+                                           (mean3, var3, bm3, bv3)):
+                    ra_m.value = m * ra_m.value + (1 - m) * bm
+                    ra_v.value = m * ra_v.value + (1 - m) * bv
+            return y
+        s1, b1 = fbn._fold_bn(g1, be1, mean1.value,
+                              jax.lax.rsqrt(var1.value
+                                            + _BATCH_NORM_EPSILON))
+        s2, b2 = fbn._fold_bn(g2, be2, mean2.value,
+                              jax.lax.rsqrt(var2.value
+                                            + _BATCH_NORM_EPSILON))
+        s3, b3 = fbn._fold_bn(g3, be3, mean3.value,
+                              jax.lax.rsqrt(var3.value
+                                            + _BATCH_NORM_EPSILON))
+        return fbn.bottleneck_apply(x, w1m, w2, w3m, s1, b1, s2, b2,
+                                    s3, b3)
 
 
 class BuildingBlock(nn.Module):
@@ -353,7 +422,8 @@ class BlockLayer(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool):
         block_cls = BottleneckBlock if self.bottleneck else BuildingBlock
-        fused_cls = FusedBuildingBlock
+        fused_cls = (FusedBottleneckBlock if self.bottleneck
+                     else FusedBuildingBlock)
         if self.remat:
             # Rematerialize per block: activations are recomputed in the
             # backward pass instead of stored — trades ~33% more FLOPs in
@@ -363,11 +433,17 @@ class BlockLayer(nn.Module):
             # bool must stay a Python static.
             block_cls = nn.remat(block_cls, static_argnums=(2,))
             fused_cls = nn.remat(fused_cls, static_argnums=(2,))
-        fuse = self.fused and not self.bottleneck
+        # Hybrid dispatch: only the stride-1 identity blocks fuse, and
+        # bottlenecks only at widths with a VMEM-sized tile plan.
+        fuse = self.fused and (not self.bottleneck
+                               or self.filters in _FUSED_BOTTLENECK_WIDTHS)
         x = block_cls(self.filters, self.strides, True, self.dtype,
                       self.bn_axis_name, name="block0")(x, train)
         for i in range(1, self.blocks):
-            if fuse:
+            if fuse and self.bottleneck:
+                x = fused_cls(self.filters, self.dtype,
+                              name=f"block{i}")(x, train)
+            elif fuse:
                 x = fused_cls(self.filters, self.dtype, self.fused_tile,
                               name=f"block{i}")(x, train)
             else:
@@ -496,7 +572,8 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
                        dtype: Dtype = jnp.bfloat16,
                        bn_axis_name: Optional[str] = None,
                        stem_space_to_depth: bool = True,
-                       remat: bool = False) -> ResNetV2:
+                       remat: bool = False,
+                       fused_blocks: bool = False) -> ResNetV2:
     """ImageNet ResNet-v2 18/34/50/101/152/200
     (reference resnet_model_official.py:350-366)."""
     if resnet_size not in _IMAGENET_PARAMS:
@@ -515,4 +592,5 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
         bn_axis_name=bn_axis_name,
         stem_space_to_depth=stem_space_to_depth,
         remat=remat,
+        fused_blocks=fused_blocks,
     )

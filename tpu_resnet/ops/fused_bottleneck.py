@@ -70,6 +70,12 @@ def _tiles_for(f: int, b: int, h: int, batch_tile=None, row_tile=None):
     ht = row_tile or dht
     bt = min(bt, b)
     ht = min(ht, h)
+    if row_tile is None:
+        # Default plans are sized for the rn50 stage heights (56/28/14);
+        # other heights (64² inputs → 16, tiny test shapes) take the
+        # largest even divisor at or under the default.
+        while ht > 1 and (h % ht or ht % 2):
+            ht -= 1
     if b % bt:
         raise ValueError(f"batch {b} not divisible by batch_tile {bt}")
     if h % ht:
@@ -77,7 +83,10 @@ def _tiles_for(f: int, b: int, h: int, batch_tile=None, row_tile=None):
     if ht % 2:
         # 2-row backward halo specs index in 2-row blocks; odd tiles would
         # misalign them.
-        raise ValueError(f"row_tile must be even, got {ht}")
+        raise ValueError(f"row_tile must be even (height {h} has no even "
+                         f"divisor <= {min(dht or h, h)})"
+                         if row_tile is None else
+                         f"row_tile must be even, got {ht}")
     return bt, ht
 
 
