@@ -1,0 +1,142 @@
+"""Collect the round-4 measurement artifacts into one summary table —
+what landed, what's pending, and the headline numbers, so a glance at
+``python tools/battery_summary.py`` (or the committed
+docs/runs/summary_r4.json) answers "what did the live windows produce"
+without spelunking a dozen JSONs.
+
+Tolerant by design: every artifact is optional (the tunnel decides what
+lands), torn files read as status=unreadable, and the decisive A/B
+verdicts are computed with the same speedup>1 rule the gated battery
+stages use.
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNS = os.path.join(REPO, "docs", "runs")
+
+
+def _load(name):
+    path = os.path.join(RUNS, name)
+    if not os.path.exists(path):
+        return None, "pending"
+    try:
+        with open(path) as f:
+            return json.load(f), "ok"
+    except (ValueError, OSError) as e:
+        return None, f"unreadable: {e}"
+
+
+def _ab_verdict(art):
+    """Per-direction best speedup across shapes + the gated-stage rule."""
+    if not art:
+        return None
+    dirs = {}
+    for shape in art.get("by_shape", {}).values():
+        for name, d in shape.items():
+            if isinstance(d, dict) and "speedup" in d:
+                dirs.setdefault(name, []).append(d["speedup"])
+    if not dirs:
+        return {"any_win": None, "note": "no measured directions"}
+    return {
+        "best_speedup_by_direction": {k: max(v) for k, v in dirs.items()},
+        "any_win": any(s > 1.0 for v in dirs.values() for s in v),
+    }
+
+
+def main() -> int:
+    out = {}
+
+    bench, st = _load("bench_r4_tpu_v5e.json")
+    out["bench"] = {"status": st}
+    if bench:
+        out["bench"].update({
+            "cifar_steps_per_sec": bench.get("value"),
+            "vs_baseline": bench.get("vs_baseline"),
+            "imagenet": bench.get("imagenet"),
+        })
+
+    for name, key in (("fused_block_ab_r4.json", "fused_block_kernel_ab"),
+                      ("fused_bottleneck_ab_r4.json",
+                       "fused_bottleneck_kernel_ab")):
+        art, st = _load(name)
+        out[key] = {"status": st}
+        v = _ab_verdict(art)
+        if v:
+            out[key].update(v)
+
+    for name, key in (("fused_model_ab_r4.json", "fused_model_cifar_ab"),
+                      ("fused_model_imagenet_ab_r4.json",
+                       "fused_model_imagenet_ab")):
+        art, st = _load(name)
+        out[key] = {"status": st}
+        if art:
+            out[key].update({
+                "steps_per_sec": art.get("steps_per_sec"),
+                "fused_speedup": art.get("fused_speedup"),
+                "fused_wins": art.get("fused_wins"),
+            })
+
+    art, st = _load("cifar_cost_r4.json")
+    out["cifar_roofline"] = {"status": st}
+    if art:
+        out["cifar_roofline"].update({
+            "steps_per_sec": art.get("steps_per_sec"),
+            "mfu": art.get("mfu"),
+        })
+
+    art, st = _load("sweeps_r4.json")
+    out["sweeps"] = {"status": st}
+    if art:
+        out["sweeps"].update(art)
+
+    art, st = _load("streaming_gap_r4.json")
+    out["streaming_gap"] = {"status": st}
+    if art:
+        out["streaming_gap"].update(
+            {k: art[k] for k in art if k.endswith("steps_per_sec")})
+
+    for b in (128, 256):
+        art, st = _load(f"mfu_b{b}_r4.json")
+        out[f"imagenet_mfu_b{b}"] = {"status": st}
+        if art:
+            out[f"imagenet_mfu_b{b}"].update({
+                "steps_per_sec": art.get("steps_per_sec"),
+                "mfu": art.get("mfu"),
+            })
+
+    art, st = _load("imagenet_stream_r4.json")
+    out["imagenet_streaming"] = {"status": st}
+    if art:
+        out["imagenet_streaming"].update({
+            "sustained_steps_per_sec": art.get("sustained_steps_per_sec"),
+            "images_per_sec": art.get("images_per_sec"),
+        })
+
+    art, st = _load(os.path.join("recipe_rehearsal_r4", "summary.json"))
+    out["recipe_rehearsal"] = {"status": st}
+    if art:
+        out["recipe_rehearsal"].update(art)
+
+    art, st = _load("multihost_2proc_r4.json")
+    out["multihost_2proc"] = {"status": st}
+    if art:
+        out["multihost_2proc"].update({
+            "spmd_identical": art.get("spmd_identical"),
+            "topology": art.get("topology"),
+        })
+
+    landed = sum(1 for v in out.values() if v.get("status") == "ok")
+    out["_meta"] = {"artifacts_landed": landed, "artifacts_total": len(out)}
+    print(json.dumps(out, indent=2))
+    dest = os.path.join(RUNS, "summary_r4.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

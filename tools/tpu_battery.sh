@@ -49,6 +49,10 @@ for extra in "$REPO"/tools/battery.d/*.sh; do
   fi
 done
 
+# Refresh the one-glance artifact roll-up after every battery pass
+# (tolerant of pending/torn artifacts by design).
+python tools/battery_summary.py >/dev/null 2>&1 || true
+
 # DONE only when every known stage is complete.
 all=yes
 for extra in "$REPO"/tools/battery.d/*.sh; do
