@@ -13,7 +13,7 @@
 # leaves a DONE marker; the watcher exits after one successful battery.
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r4}"
+OUT="${1:-$REPO/docs/runs/watch_r$(cat "$REPO/tools/BATTERY_ROUND")}"
 DEADLINE="${2:-$(($(date +%s) + 11 * 3600))}"
 PROBE_TIMEOUT="${TPU_WATCH_PROBE_TIMEOUT:-60}"
 SLEEP="${TPU_WATCH_SLEEP:-90}"

@@ -1,0 +1,99 @@
+"""The shared A/B gate rule (tools/ab_gate.py) and the battery stages'
+gate semantics. Review finding r5: a MISSING gate artifact used to exit 0
+("skipping"), which tools/tpu_battery.sh marks as permanently done — one
+stage-05 crash would have disarmed the decisive gated stages 55/56 for
+the rest of the round. Missing must mean retry (exit 1); only a measured
+loss may mark the stage done."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import ab_gate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_gate_rule_win_loss_unreadable(tmp_path):
+    win = tmp_path / "win.json"
+    win.write_text(json.dumps(
+        {"by_shape": {"s": {"fwd": {"speedup": 1.3},
+                            "bwd": {"speedup": 0.7}}}}))
+    loss = tmp_path / "loss.json"
+    loss.write_text(json.dumps(
+        {"by_shape": {"s": {"fwd": {"speedup": 0.8}}}}))
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"by_shape": {')
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"by_shape": {}}))
+    assert ab_gate.main(["ab_gate", str(win)]) == 0
+    assert ab_gate.main(["ab_gate", str(loss)]) == 1
+    assert ab_gate.main(["ab_gate", str(torn)]) == 2
+    assert ab_gate.main(["ab_gate", str(empty)]) == 2
+    assert ab_gate.main(["ab_gate", str(tmp_path / "nope.json")]) == 2
+
+
+def _run_stage(name, tmp_path, env_gates):
+    """Run a battery stage with its gate paths redirected into tmp_path —
+    tests must not depend on live repo artifact state (stage 05 may land
+    its artifact mid-round) nor risk launching a real 2700s A/B on a
+    fabricated winning gate."""
+    out = tmp_path / "out"
+    out.mkdir(exist_ok=True)
+    env = dict(os.environ)
+    env.update(env_gates)
+    return subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "battery.d", name), str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=120, cwd=REPO, env=env)
+
+
+def test_stage55_missing_gate_retries_not_done(tmp_path):
+    """Stage 05's artifact does not exist: stage 55 must exit nonzero so
+    the battery keeps it armed (a crash must not disarm the gate)."""
+    proc = _run_stage(
+        "55_fused_bottleneck_ab.sh", tmp_path,
+        {"FUSED_AB_GATE": str(tmp_path / "absent_05.json")})
+    assert proc.returncode == 1
+    assert "retry" in proc.stdout
+
+
+def test_stage55_measured_loss_skips_done(tmp_path):
+    """A measured loss at stage 05 is a standing negative result: stage 55
+    skips (exit 0 → marked done) without launching the A/B."""
+    gate = tmp_path / "loss_05.json"
+    gate.write_text(json.dumps(
+        {"by_shape": {"s": {"fwd": {"speedup": 0.8}}}}))
+    proc = _run_stage("55_fused_bottleneck_ab.sh", tmp_path,
+                      {"FUSED_AB_GATE": str(gate)})
+    assert proc.returncode == 0
+    assert "no winning direction" in proc.stdout
+
+
+def test_stage56_missing_gates_retries_not_done(tmp_path):
+    """Neither stage 55's nor stage 05's artifact exists: stage 56 cannot
+    distinguish 'gated off by a measured loss' from 'not yet run' — it
+    must stay armed (exit 1), not mark itself done."""
+    proc = _run_stage(
+        "56_fused_model_imagenet_ab.sh", tmp_path,
+        {"FUSED_AB_GATE": str(tmp_path / "absent_05.json"),
+         "FUSED_BOTTLENECK_AB_GATE": str(tmp_path / "absent_55.json")})
+    assert proc.returncode == 1
+    assert "retry" in proc.stdout
+
+
+def test_stage56_skips_done_when_05_measured_loss(tmp_path):
+    """Stage 55's artifact is missing BECAUSE stage 05 measured a loss:
+    that is the one legitimate skip-forever case for stage 56."""
+    gate05 = tmp_path / "loss_05.json"
+    gate05.write_text(json.dumps(
+        {"by_shape": {"s": {"fwd": {"speedup": 0.8}}}}))
+    proc = _run_stage(
+        "56_fused_model_imagenet_ab.sh", tmp_path,
+        {"FUSED_AB_GATE": str(gate05),
+         "FUSED_BOTTLENECK_AB_GATE": str(tmp_path / "absent_55.json")})
+    assert proc.returncode == 0
+    assert "negative result stands" in proc.stdout

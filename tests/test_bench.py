@@ -170,13 +170,33 @@ def test_cached_tpu_snapshot_picks_newest_archived_artifact():
     assert cached["snapshot"] == json.load(open(best[1]))
     assert cached["snapshot"]["backend"] == "tpu"
     assert "NOT measured" in cached["provenance"]
+    # Provenance timestamp source is explicit (ADVICE r4): either stamped
+    # at measurement time inside the artifact, or labeled as file mtime.
+    assert cached["archived_at_source"] in ("captured_at", "file_mtime")
+    if "captured_at" in cached["snapshot"]:
+        assert cached["archived_at"] == cached["snapshot"]["captured_at"]
 
 
-def test_emit_attaches_cache_only_on_non_tpu_backends(capsys):
+def test_emit_attaches_compact_cache_only_on_non_tpu_backends(capsys):
+    """The inline cache is a SUMMARY (round-4 postmortem: inlining the
+    full snapshot made the emit line ~3 KB and the driver's bounded tail
+    truncated it mid-string — parsed=null). The full snapshot goes to a
+    file the summary points at."""
     import json
+    import os
     bench._emit({"backend": "cpu"}, 1.5)
     line = json.loads(capsys.readouterr().out)
-    assert line["cached_tpu_snapshot"]["snapshot"]["backend"] == "tpu"
+    cache = line["cached_tpu_snapshot"]
+    assert "snapshot" not in cache            # full snapshot not inlined
+    best = _newest_archived_tpu()
+    snap = json.load(open(best[1]))
+    assert cache["value"] == snap["value"]
+    assert cache["metric"] == snap["metric"]
+    assert cache["archived_round"] == best[0]
+    here = os.path.dirname(os.path.abspath(bench.__file__))
+    full = json.load(open(os.path.join(here, cache["full_snapshot_file"])))
+    assert full["snapshot"] == snap
+    assert len(json.dumps(line)) < 1500       # fits a bounded stdout tail
     bench._emit({"backend": "tpu"}, 100.0)
     line = json.loads(capsys.readouterr().out)
     assert "cached_tpu_snapshot" not in line
@@ -185,7 +205,9 @@ def test_emit_attaches_cache_only_on_non_tpu_backends(capsys):
 def test_down_tunnel_bench_emits_cached_snapshot():
     """Simulated down tunnel end to end: scrubbed CPU env (probe sees cpu,
     which the watcher rejects as 'down'), fallback disabled like the
-    battery does — the emitted line must still carry chip truth."""
+    battery does — the emitted line must still carry chip truth, and the
+    run must exit 0 (a parseable record was produced; consumers judge
+    quality by backend/partial, not rc)."""
     import json
     import subprocess
     import sys
@@ -199,16 +221,79 @@ def test_down_tunnel_bench_emits_cached_snapshot():
                           text=True, timeout=300, cwd=bench.os.path.dirname(
                               bench.os.path.abspath(bench.__file__)))
     line = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert proc.returncode == 1          # honest: no live measurement
+    assert proc.returncode == 0
     assert line["backend"] == "none"
     best = _newest_archived_tpu()
-    assert line["cached_tpu_snapshot"]["snapshot"] == json.load(open(best[1]))
+    snap = json.load(open(best[1]))
+    assert line["cached_tpu_snapshot"]["value"] == snap["value"]
+    assert line["cached_tpu_snapshot"]["archived_round"] == best[0]
     assert line["value"] is None          # headline stays a live-only field
+
+
+def test_bounded_budget_exits_zero_with_small_parseable_line():
+    """VERDICT r4 acceptance: ``BENCH_WATCH_WINDOW=120 timeout 300 python
+    bench.py`` on a dead tunnel exits 0 inside the budget with a complete,
+    small, parseable last line — plus a provisional line emitted early so
+    an even-shorter parent timeout still captures a record."""
+    import json
+    import subprocess
+    import sys
+    import time as _time
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(1)
+    # CPU fallback pinned off: with the scrubbed env's fast-failing probe
+    # the fallback child would otherwise run real jax-on-CPU work and make
+    # the wall-time assert flaky on the one-core box. Every asserted
+    # behavior (provisional first line, bounded exit 0, cached summary on
+    # the final line) is unaffected.
+    env.update(BENCH_WATCH_WINDOW="120", BENCH_CPU_FALLBACK="0")
+    t0 = _time.monotonic()
+    proc = subprocess.run([sys.executable, "bench.py"], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True, timeout=300, cwd=bench.os.path.dirname(
+                              bench.os.path.abspath(bench.__file__)))
+    wall = _time.monotonic() - t0
+    assert proc.returncode == 0
+    assert wall < 150, f"must finish inside the budget, took {wall:.0f}s"
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert json.loads(lines[0]).get("provisional") is True
+    final = json.loads(lines[-1])
+    assert final.get("provisional") is None
+    assert "cached_tpu_snapshot" in final
+    assert len(lines[-1]) < 1500          # survives a bounded tail capture
+
+
+def test_max_probe_fails_returns_to_outer_watcher_quickly():
+    """tools/battery.d/10_bench.sh runs bench.py with a child-sized budget
+    but owns polling itself: BENCH_MAX_PROBE_FAILS must bound the nested
+    watch to minutes when the tunnel died between the watcher's probe and
+    the stage (review finding r5)."""
+    import json
+    import subprocess
+    import sys
+    import time as _time
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(1)
+    env.update(BENCH_WATCH_WINDOW="600", BENCH_CPU_FALLBACK="0",
+               BENCH_POLL_SLEEP="1", BENCH_MAX_PROBE_FAILS="2",
+               BENCH_PROVISIONAL="0")
+    t0 = _time.monotonic()
+    proc = subprocess.run([sys.executable, "bench.py"], env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True, timeout=300, cwd=bench.os.path.dirname(
+                              bench.os.path.abspath(bench.__file__)))
+    assert proc.returncode == 0
+    assert _time.monotonic() - t0 < 120   # 2 fast probes, not 600s of polls
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "BENCH_MAX_PROBE_FAILS" in line["error"]
 
 
 def test_sigterm_flush_carries_cached_snapshot():
     """Driver SIGTERMs the watcher mid-window (the BENCH_r03 death mode):
-    the handler must flush one JSON line immediately, cache attached."""
+    the handler — now a backstop, not the normal path — must still flush
+    one small JSON line immediately, cache summary attached."""
     import json
     import signal
     import subprocess
@@ -218,7 +303,8 @@ def test_sigterm_flush_carries_cached_snapshot():
 
     env = scrubbed_cpu_env(1)
     env.update(BENCH_WATCH_WINDOW="600", BENCH_PROBE_TIMEOUT="60",
-               BENCH_CPU_FALLBACK="0", BENCH_TPU_ATTEMPTS="1")
+               BENCH_CPU_FALLBACK="0", BENCH_TPU_ATTEMPTS="1",
+               BENCH_PROVISIONAL="0")
     proc = subprocess.Popen([sys.executable, "bench.py"], env=env,
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
                             text=True, cwd=bench.os.path.dirname(
@@ -229,4 +315,7 @@ def test_sigterm_flush_carries_cached_snapshot():
     line = json.loads(out.strip().splitlines()[-1])
     assert line["backend"] == "none"
     assert "SIGTERM" in line["error"]
-    assert line["cached_tpu_snapshot"]["snapshot"]["backend"] == "tpu"
+    cache = line["cached_tpu_snapshot"]
+    assert "snapshot" not in cache
+    assert cache["value"] is not None
+    assert len(json.dumps(line)) < 1500

@@ -1,0 +1,126 @@
+"""The compile-smoke prelude (tools/pallas_compile_smoke.py) and the
+battery stages' skip logic around it (VERDICT r4 item 3): a Mosaic
+lowering failure on the first live window must cost ~a minute and yield
+the window to the headline bench — not burn the 1800 s A/B budget."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SMOKE = os.path.join(REPO, "tools", "pallas_compile_smoke.py")
+
+
+def _run_smoke(tmp_path, family, extra=()):
+    """Always under the scrubbed CPU env: the ambient env carries the
+    axon TPU plugin, and importing jax there HANGS when the tunnel is
+    down — a test must never block on tunnel state."""
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    out = tmp_path / f"smoke_{family}.json"
+    proc = subprocess.run(
+        [sys.executable, SMOKE, "--family", family, "--out", str(out),
+         *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=600, cwd=REPO, env=scrubbed_cpu_env(1))
+    return proc, (json.loads(out.read_text()) if out.exists() else None)
+
+
+def test_smoke_block_interpret_passes_oracle(tmp_path):
+    """Interpret mode runs anywhere: all four block directions compile
+    (as XLA ops on CPU) and match the oracle."""
+    proc, art = _run_smoke(tmp_path, "block", ("--interpret",))
+    assert proc.returncode == 0, proc.stdout
+    assert art["compile_ok"] is True
+    assert set(art["checks"]) == {"fwd_max_err", "bwd_max_err",
+                                  "train_fwd_max_err", "train_bwd_max_err"}
+    assert all(v < 2e-2 for v in art["checks"].values())
+
+
+def test_smoke_bottleneck_interpret_passes_oracle(tmp_path):
+    proc, art = _run_smoke(tmp_path, "bottleneck", ("--interpret",))
+    assert proc.returncode == 0, proc.stdout
+    assert art["compile_ok"] is True
+    assert set(art["checks"]) == {"fwd_max_err", "bwd_max_err"}
+
+
+def test_smoke_failure_writes_gate_compatible_artifact(tmp_path):
+    """Non-interpret mode on the scrubbed CPU backend: whatever Pallas
+    does there, the smoke must produce a gate-compatible verdict — a
+    clean pass (some jax versions lower Pallas natively on CPU), or exit
+    1 with the error captured and compile_ok=false + empty by_shape (the
+    shape ab_gate reads as a standing loss)."""
+    proc, art = _run_smoke(tmp_path, "block")  # non-interpret on CPU
+    if proc.returncode == 0:
+        # The forced-failure stage path is covered by the
+        # COMPILE_SMOKE_FORCE tests below either way.
+        assert art["compile_ok"] is True
+        return
+    assert art["compile_ok"] is False
+    assert art["error"]
+    assert art["by_shape"] == {}
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ab_gate
+    gate_art = tmp_path / "smoke_block.json"
+    assert ab_gate.main(["ab_gate", str(gate_art)]) == 1  # standing loss
+
+
+def _run_stage(name, tmp_path, env_extra):
+    out = tmp_path / "out"
+    out.mkdir(exist_ok=True)
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(
+        ["bash", os.path.join(REPO, "tools", "battery.d", name), str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=120, cwd=REPO, env=env)
+
+
+def test_stage05_smoke_failure_archives_and_yields(tmp_path):
+    """Forced smoke failure: stage 05 exits 0 (done — the battery falls
+    through to stage 10) with the failure archived as the A/B artifact,
+    which the downstream gates read as a measured loss."""
+    smoke = tmp_path / "smoke.json"
+    ab_out = tmp_path / "ab.json"
+    proc = _run_stage("05_fused_block_ab.sh", tmp_path, {
+        "COMPILE_SMOKE_FORCE": "fail",
+        "COMPILE_SMOKE_OUT": str(smoke),
+        "FUSED_BLOCK_AB_OUT": str(ab_out)})
+    assert proc.returncode == 0
+    assert "A/B skipped" in proc.stdout
+    art = json.loads(ab_out.read_text())
+    assert art["compile_ok"] is False
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ab_gate
+    assert ab_gate.main(["ab_gate", str(ab_out)]) == 1
+
+
+def test_stage05_smoke_timeout_retries(tmp_path):
+    """A smoke timeout is a tunnel flake, not infeasibility: the stage
+    must stay armed (exit 1) and archive nothing."""
+    ab_out = tmp_path / "ab.json"
+    proc = _run_stage("05_fused_block_ab.sh", tmp_path, {
+        "COMPILE_SMOKE_FORCE": "timeout",
+        "COMPILE_SMOKE_OUT": str(tmp_path / "smoke.json"),
+        "FUSED_BLOCK_AB_OUT": str(ab_out)})
+    assert proc.returncode == 1
+    assert "retry" in proc.stdout
+    assert not ab_out.exists()
+
+
+def test_stage55_smoke_failure_archives_and_yields(tmp_path):
+    """Same discipline for the bottleneck stage — with its 05 gate fed a
+    winning artifact so the stage reaches the smoke."""
+    gate05 = tmp_path / "win05.json"
+    gate05.write_text(json.dumps(
+        {"by_shape": {"s": {"fwd": {"speedup": 1.3}}}}))
+    ab_out = tmp_path / "ab55.json"
+    proc = _run_stage("55_fused_bottleneck_ab.sh", tmp_path, {
+        "FUSED_AB_GATE": str(gate05),
+        "COMPILE_SMOKE_FORCE": "fail",
+        "COMPILE_SMOKE_OUT": str(tmp_path / "smoke55.json"),
+        "FUSED_BOTTLENECK_AB_OUT": str(ab_out)})
+    assert proc.returncode == 0
+    assert "A/B skipped" in proc.stdout
+    assert json.loads(ab_out.read_text())["compile_ok"] is False

@@ -178,29 +178,92 @@ def test_fused_composes_with_remat(setup):
             rtol=1e-5, atol=1e-6, err_msg=jax.tree_util.keystr(path))
 
 
-def test_fused_blocks_rejected_for_imagenet_basic_nets():
-    """model.fused_blocks on ImageNet ResNet-18/34 (basic blocks at
-    ImageNet shapes, no sized tile plan) must fail loudly; bottleneck
-    sizes dispatch to FusedBottleneckBlock."""
+def test_imagenet_basic_nets_accept_fused_blocks():
+    """ImageNet ResNet-18/34 fused dispatch (VERDICT r4 item 8 — replaces
+    the old rejection test): the basic-block stages at 56²/28²/14² get
+    VMEM-derived tile plans; bottleneck sizes keep FusedBottleneckBlock."""
     from tpu_resnet.config import load_config
     from tpu_resnet.models import build_model
     from tpu_resnet.models.resnet import ResNetV2
 
     cfg = load_config("imagenet")
     cfg.model.fused_blocks = True
-    cfg.model.resnet_size = 18
-    with pytest.raises(ValueError, match="18/34"):
-        build_model(cfg)
-    cfg.model.resnet_size = 50
-    model = build_model(cfg)
-    assert isinstance(model, ResNetV2) and model.fused_blocks
+    for size in (18, 50):
+        cfg.model.resnet_size = size
+        model = build_model(cfg)
+        assert isinstance(model, ResNetV2) and model.fused_blocks
+
+
+def test_auto_batch_tile_plans():
+    """The VMEM tile-plan arithmetic behind the dispatch: CIFAR shapes
+    keep the measured bt=16; ImageNet basic shapes get plans that fit;
+    the 7²x512 stage (weights ~18.9 MB alone) raises so BlockLayer keeps
+    it on XLA."""
+    from tpu_resnet.ops.fused_block import auto_batch_tile
+
+    # CIFAR stage shapes at b128: unchanged measured default.
+    assert auto_batch_tile((128, 32, 32, 16)) == 16
+    assert auto_batch_tile((128, 16, 16, 32)) == 16
+    assert auto_batch_tile((128, 8, 8, 64)) == 16
+    # ImageNet rn18/34 basic stage shapes at b128: a plan exists, divides
+    # the batch, and its forward live set fits the 10 MB budget.
+    for shape in ((128, 56, 56, 64), (128, 28, 28, 128),
+                  (128, 14, 14, 256)):
+        bt = auto_batch_tile(shape)
+        assert bt >= 1 and 128 % bt == 0
+        b, h, w, c = shape
+        live = bt * h * w * c * 4 * 4 + 2 * 9 * c * c * 4
+        assert live <= 10 * 2 ** 20, (shape, bt, live)
+    with pytest.raises(ValueError, match="XLA"):
+        auto_batch_tile((128, 7, 7, 512))
+
+
+def test_imagenet_rn18_fused_forward_equivalence():
+    """Oracle equivalence of the fused rn18 dispatch at (downscaled-batch)
+    ImageNet stage geometry: eval + train forward through BlockLayer with
+    fused on/off must match. Interpret-mode kernels on CPU; the chip A/B
+    is armed behind the stage-05 gate (battery stage 58)."""
+    from tpu_resnet.models.resnet import BlockLayer
+
+    rng = jax.random.PRNGKey(0)
+    # Stage geometries from imagenet_resnet_v2(18): (filters, spatial) —
+    # batch 2 keeps the CPU test fast; the tile plan still engages.
+    for filters, hw in ((64, 56), (128, 28)):
+        x = jax.random.normal(rng, (2, hw, hw, filters), jnp.float32)
+        out = {}
+        for fused in (False, True):
+            layer = BlockLayer(filters=filters, blocks=2, strides=1,
+                               bottleneck=False, dtype=jnp.float32,
+                               fused=fused)
+            variables = layer.init(jax.random.PRNGKey(1), x, train=False)
+            out[fused] = layer.apply(variables, x, train=False)
+        np.testing.assert_allclose(np.asarray(out[True]),
+                                   np.asarray(out[False]),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_imagenet_basic_512_stage_stays_xla():
+    """The planless 7²x512 stage must dispatch to the XLA BuildingBlock —
+    hybrid dispatch, mirroring the f=512 bottleneck exclusion."""
+    from tpu_resnet.models.resnet import BlockLayer
+
+    x = jnp.zeros((2, 7, 7, 512), jnp.float32)
+    layer = BlockLayer(filters=512, blocks=2, strides=1, bottleneck=False,
+                       dtype=jnp.float32, fused=True)
+    # If the fused path engaged, FusedBuildingBlock's auto_batch_tile
+    # would raise (weights ~18.9 MB exceed the plan budget); a clean init
+    # + forward proves the hybrid dispatch fell back to XLA.
+    variables = layer.init(jax.random.PRNGKey(0), x, train=False)
+    y = layer.apply(variables, x, train=False)
+    assert y.shape == x.shape
 
 
 def test_fused_matches_xla_on_8device_mesh():
     """On the virtual 8-device mesh (interpret-mode kernels lower to
     regular XLA ops) the fused path reproduces the sync-BN XLA path's
-    losses under auto-sharding. Real-TPU multi-chip (non-interpret custom
-    call) remains unvalidated — see FusedBuildingBlock's caveat."""
+    losses under auto-sharding. The SUPPORTED multi-chip dispatch is the
+    shard_map-explicit one (next test); this pins the jit path's numerics
+    where it still applies (single-chip and virtual-mesh A/Bs)."""
     from tpu_resnet.config import load_config
     from tpu_resnet import parallel
     from tpu_resnet.data.cifar import synthetic_data
@@ -237,6 +300,73 @@ def test_fused_matches_xla_on_8device_mesh():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_fused_shardmap_matches_xla_shardmap_on_8device_mesh():
+    """The shard_map-EXPLICIT fused dispatch (VERDICT r4 item 5 — the
+    supported multi-chip story for model.fused_blocks): fused vs XLA
+    through the per-replica-BN shard_map path must track each other, both
+    seeing only their local batch shard. Kernel interpret mode lowers to
+    XLA ops here; the real-chip non-interpret analog is battery stage 57
+    (tools/fused_shardmap_smoke.py)."""
+    from tpu_resnet.config import load_config
+    from tpu_resnet import parallel
+    from tpu_resnet.data.cifar import synthetic_data
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import build_schedule, init_state
+    from tpu_resnet.train.step import make_train_step, shard_step
+
+    losses = {}
+    for fused in (False, True):
+        cfg = load_config("smoke")
+        cfg.model.resnet_size = SIZE
+        cfg.model.compute_dtype = "float32"
+        cfg.model.fused_blocks = fused
+        cfg.model.sync_bn = False
+        cfg.train.global_batch_size = 16
+        mesh = parallel.create_mesh(cfg.mesh)
+        model = build_model(cfg)
+        sched = build_schedule(cfg.optim, cfg.train)
+        state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)))
+        state = jax.device_put(state, parallel.replicated(mesh))
+        step_fn = shard_step(
+            make_train_step(model, cfg.optim, sched, 10, augment_fn=None,
+                            base_rng=jax.random.PRNGKey(1),
+                            grad_axis="data"),
+            mesh, per_replica_bn=True)
+        images, labels = synthetic_data(32, 32, 10, seed=0)
+        bs = parallel.batch_sharding(mesh)
+        run = []
+        for i in range(3):
+            gi = jax.device_put(
+                jnp.asarray(images[(i * 16) % 32:(i * 16) % 32 + 16]), bs)
+            gl = jax.device_put(jnp.asarray(
+                labels[(i * 16) % 32:(i * 16) % 32 + 16].astype(np.int32)),
+                bs)
+            state, metrics = step_fn(state, gi, gl)
+            run.append(float(jax.device_get(metrics["loss"])))
+        losses[fused] = run
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_loop_rejects_sync_bn_multichip(tmp_path):
+    """The train loop guard (VERDICT r4 item 5): fused_blocks + sync_bn
+    on a multi-device data axis must fail loudly, and flipping
+    sync_bn=false is the documented fix."""
+    from tpu_resnet.config import load_config
+    from tpu_resnet.train.loop import train as train_loop
+
+    cfg = load_config("smoke")
+    cfg.model.resnet_size = SIZE
+    cfg.model.fused_blocks = True
+    cfg.train.global_batch_size = 16
+    cfg.train.train_steps = 1
+    cfg.train.train_dir = str(tmp_path / "run")
+    assert cfg.model.sync_bn
+    with pytest.raises(ValueError, match="sync_bn"):
+        train_loop(cfg)
+
+
 def test_fused_blocks_rejected_for_wide_resnet():
     from tpu_resnet.config import load_config
     from tpu_resnet.models import build_model
@@ -245,6 +375,35 @@ def test_fused_blocks_rejected_for_wide_resnet():
     cfg.model.fused_blocks = True
     with pytest.raises(ValueError, match="width_multiplier"):
         build_model(cfg)
+
+
+def test_direct_constructors_carry_the_same_fused_guards():
+    """ADVICE r4: the fused_blocks guards must live in the generators,
+    not only build_model — a direct cifar_resnet_v2 call must fail with
+    the same clear message, not an obscure downstream tile error. (The
+    old 18/34 rejection is gone: those sizes now carry tile plans —
+    VERDICT r4 item 8.)"""
+    from tpu_resnet.models.resnet import cifar_resnet_v2, imagenet_resnet_v2
+
+    with pytest.raises(ValueError, match="width_multiplier"):
+        cifar_resnet_v2(28, 100, width_multiplier=10, fused_blocks=True)
+    assert imagenet_resnet_v2(18, 1000, fused_blocks=True).fused_blocks
+    assert imagenet_resnet_v2(50, 1000, fused_blocks=True).fused_blocks
+
+
+def test_fused_blocks_reject_sync_bn_axis():
+    """ADVICE r4 (fail-loud): the fused kernels compute batch moments per
+    replica with no axis sync — combining fused_blocks with a sync-BN
+    bn_axis_name must raise, at the constructor and at BlockLayer level."""
+    from tpu_resnet.models.resnet import BlockLayer, cifar_resnet_v2
+
+    with pytest.raises(ValueError, match="sync-BN"):
+        cifar_resnet_v2(8, 10, bn_axis_name="data", fused_blocks=True)
+    layer = BlockLayer(filters=16, blocks=2, strides=1, bottleneck=False,
+                       dtype=jnp.float32, bn_axis_name="data", fused=True)
+    with pytest.raises(ValueError, match="sync-BN"):
+        layer.init(jax.random.PRNGKey(0), jnp.zeros((2, 8, 8, 16)),
+                   train=True)
 
 
 # --- FusedBottleneckBlock (ImageNet generator) ---------------------------

@@ -1,0 +1,54 @@
+"""Shared win/loss rule for the gated fused-kernel battery stages.
+
+One place owns "did the A/B show a winning direction" so the gate in
+stage 55, the loss-detector and gate in stage 56, and the summary's
+verdict (tools/battery_summary.py) cannot desynchronize (review finding
+r5: the 6-line by_shape/speedup>1 computation was copy-pasted four
+times).
+
+Exit codes, matching the stages' historical contract:
+    0  at least one measured direction has speedup > WIN_THRESHOLD
+    1  measured loss — no direction wins (a standing negative result)
+    2  artifact unreadable / no measured directions (infra error: the
+       battery retries instead of recording a crash as a loss)
+
+Usage: ``python tools/ab_gate.py ARTIFACT.json``
+"""
+
+import json
+import sys
+
+WIN_THRESHOLD = 1.0
+
+
+def wins(artifact: dict):
+    """Per-direction win booleans across all shapes of an A/B artifact."""
+    return [d.get("speedup", 0) > WIN_THRESHOLD
+            for shape in artifact.get("by_shape", {}).values()
+            for d in shape.values() if isinstance(d, dict)]
+
+
+def main(argv):
+    try:
+        with open(argv[1]) as f:
+            r = json.load(f)
+    except Exception as e:  # torn/invalid artifact: infra error, not a loss
+        print(f"[ab_gate] artifact unreadable: {e}")
+        return 2
+    # A compile-smoke failure artifact (tools/pallas_compile_smoke.py,
+    # archived in place of the A/B by stages 05/55) is a measured
+    # infeasibility: the kernel cannot even lower on this chip, so the
+    # gated stages must stand down exactly as on a measured loss.
+    if r.get("compile_ok") is False:
+        print("[ab_gate] compile smoke failed — kernel infeasible on this "
+              "backend (standing loss)")
+        return 1
+    w = wins(r)
+    if not w:
+        print("[ab_gate] artifact has no measured directions")
+        return 2
+    return 0 if any(w) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -6,7 +6,8 @@
 # first-cache) plus measurement.
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 cd "$REPO"
 
 timeout -k 30 1800 python tools/fused_model_ab.py \
-  --out docs/runs/fused_model_ab_r4.json | tail -4
+  --out docs/runs/fused_model_ab_r${RND}.json | tail -4

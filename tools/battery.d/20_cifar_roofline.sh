@@ -7,10 +7,11 @@
 # inventory behind the number.
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 cd "$REPO"
 
-OUT="${1:-$REPO/docs/runs/watch_r4}"
+OUT="${1:-$REPO/docs/runs/watch_r${RND}}"
 timeout -k 30 900 python tools/mfu_probe.py --preset cifar10 --batch 128 \
-  --out docs/runs/cifar_cost_r4.json \
-  --hlo-gz docs/runs/hlo_cifar_b128_r4.txt.gz \
+  --out docs/runs/cifar_cost_r${RND}.json \
+  --hlo-gz docs/runs/hlo_cifar_b128_r${RND}.txt.gz \
   --trace-dir "$OUT/cifar_trace_b128" | tail -20

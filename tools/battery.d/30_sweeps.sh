@@ -6,6 +6,7 @@
 # data.device_resident defaults.
 set -eu
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 RUNS="$REPO/docs/runs"
 cd "$REPO"
 
@@ -40,6 +41,8 @@ best_streaming = max(stages.values())
 out["resident_vs_streaming"] = {
     "resident_best": best_resident, "streaming_best": best_streaming,
     "resident_wins": best_resident >= best_streaming}
-json.dump(out, open("docs/runs/sweeps_r4.json", "w"), indent=2)
+# quoted heredoc: read the round tag in-process, not via shell expansion
+rnd = open("tools/BATTERY_ROUND").read().strip()
+json.dump(out, open(f"docs/runs/sweeps_r{rnd}.json", "w"), indent=2)
 print("[sweeps]", json.dumps(out))
 EOF

@@ -6,7 +6,8 @@
 # transfer-free in the timed loop, fetch-synced timing.
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 cd "$REPO"
 
 timeout -k 30 900 python tools/streaming_gap_probe.py \
-  --out docs/runs/streaming_gap_r4.json | tail -5
+  --out docs/runs/streaming_gap_r${RND}.json | tail -5

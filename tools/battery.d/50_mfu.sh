@@ -5,19 +5,20 @@
 # and a gzipped compiled-HLO excerpt (the trace stays in the watch dir).
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r4}"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
+OUT="${1:-$REPO/docs/runs/watch_r${RND}}"
 RUNS="$REPO/docs/runs"
 cd "$REPO"
 
 timeout -k 30 900 python tools/mfu_probe.py --batch 128 \
-  --out "$RUNS/mfu_b128_r4.json" --hlo-gz "$RUNS/hlo_imagenet_b128_r4.txt.gz" \
+  --out "$RUNS/mfu_b128_r${RND}.json" --hlo-gz "$RUNS/hlo_imagenet_b128_r${RND}.txt.gz" \
   --trace-dir "$OUT/mfu_trace_b128" | tail -25
 
 timeout -k 30 900 python tools/mfu_probe.py --batch 256 \
-  --out "$RUNS/mfu_b256_r4.json" | tail -20
+  --out "$RUNS/mfu_b256_r${RND}.json" | tail -20
 
 # b512 needs block remat (activations past the 16 GB HBM ceiling);
 # failure here must not sink the stage — record and move on.
 timeout -k 30 900 python tools/mfu_probe.py --batch 512 --remat \
-  --out "$RUNS/mfu_b512_remat_r4.json" | tail -20 \
+  --out "$RUNS/mfu_b512_remat_r${RND}.json" | tail -20 \
   || echo "[mfu] b512+remat failed (recorded nothing) — not fatal"

@@ -8,28 +8,21 @@
 # a crashed evaluation as a standing loss.
 set -uo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 cd "$REPO"
 
-GATE="docs/runs/fused_block_ab_r4.json"
+# FUSED_AB_GATE override exists for tests (they must not depend on live
+# repo artifact state, nor risk launching the real 2700s A/B).
+GATE="${FUSED_AB_GATE:-docs/runs/fused_block_ab_r${RND}.json}"
 if [ ! -f "$GATE" ]; then
-  echo "[fused_bottleneck_ab] gate artifact $GATE missing (stage 05 not run?) — skipping"
-  exit 0
+  # A missing gate is NOT a negative result either: stage 05 may simply
+  # have crashed/timed out this window and will retry. Fail the stage so
+  # it stays armed; only a measured loss (below) marks it done.
+  echo "[fused_bottleneck_ab] gate artifact $GATE missing (stage 05 not run?) — will retry next window"
+  exit 1
 fi
-python - "$GATE" <<'EOF'
-import json, sys
-try:
-    r = json.load(open(sys.argv[1]))
-    wins = [d.get("speedup", 0) > 1.0
-            for shape in r.get("by_shape", {}).values()
-            for name, d in shape.items() if isinstance(d, dict)]
-except Exception as e:  # torn/invalid artifact: infra error, not a loss
-    print(f"[fused_bottleneck_ab] gate artifact unreadable: {e}")
-    sys.exit(2)
-if not wins:
-    print("[fused_bottleneck_ab] gate artifact has no measured directions")
-    sys.exit(2)
-sys.exit(0 if any(wins) else 1)
-EOF
+# Shared rule (tools/ab_gate.py): 0=win, 1=measured loss, 2=torn artifact.
+python tools/ab_gate.py "$GATE"
 rc=$?
 if [ $rc -eq 1 ]; then
   echo "[fused_bottleneck_ab] basic-block A/B shows no winning direction — skipping (negative result stands)"
@@ -39,7 +32,35 @@ elif [ $rc -eq 2 ]; then
   exit 1
 fi
 
+# Compile-smoke prelude — same rationale and error discipline as stage
+# 05's (see 05_fused_block_ab.sh): fail in ~1 min, not mid-A/B.
+# SMOKE/AB_OUT overridable + COMPILE_SMOKE_FORCE=fail|timeout: the skip
+# logic is CPU-testable (tests/test_compile_smoke.py) without touching
+# live artifacts or running a real compile.
+SMOKE="${COMPILE_SMOKE_OUT:-docs/runs/compile_smoke_bottleneck_r${RND}.json}"
+AB_OUT="${FUSED_BOTTLENECK_AB_OUT:-docs/runs/fused_bottleneck_ab_r${RND}.json}"
+case "${COMPILE_SMOKE_FORCE:-}" in
+  fail)
+    printf '{"compile_ok": false, "error": "forced by test", "by_shape": {}}' > "$SMOKE"
+    src=1 ;;
+  timeout)
+    src=124 ;;
+  *)
+    timeout -k 15 300 python tools/pallas_compile_smoke.py \
+      --family bottleneck --out "$SMOKE"
+    src=$? ;;
+esac
+if [ $src -eq 124 ] || [ $src -eq 137 ]; then
+  echo "[fused_bottleneck_ab] compile smoke timed out (tunnel flake?) — will retry next window"
+  exit 1
+elif [ $src -ne 0 ]; then
+  cp "$SMOKE" "$AB_OUT"
+  echo "[fused_bottleneck_ab] non-interpret compile FAILED — A/B skipped, error archived"
+  exit 0
+fi
+echo "[fused_bottleneck_ab] compile smoke OK — running the A/B"
+
 # 2 arms x 4 directions x 3 shapes (24 scan-program compiles); compiles
 # dominate first-cache runs.
 timeout -k 30 2700 python tools/fused_bottleneck_ab.py \
-  --out docs/runs/fused_bottleneck_ab_r4.json | tail -6
+  --out "$AB_OUT" | tail -6

@@ -6,28 +6,27 @@
 # fails the stage (retry), a genuine loss skips it (done).
 set -uo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
 cd "$REPO"
 
-GATE="docs/runs/fused_bottleneck_ab_r4.json"
+# Gate paths overridable for tests (see 55_fused_bottleneck_ab.sh).
+GATE="${FUSED_BOTTLENECK_AB_GATE:-docs/runs/fused_bottleneck_ab_r${RND}.json}"
 if [ ! -f "$GATE" ]; then
-  echo "[fused_model_imagenet_ab] gate artifact $GATE missing (stage 55 skipped or unrun) — skipping"
-  exit 0
+  # Missing ≠ loss: stage 55 may be unrun (crashed, or still gated on 05)
+  # and retrying — keep this stage armed rather than marking it done. The
+  # one legitimate skip-forever case is "stage 05 measured a loss, so 55
+  # intentionally never wrote its artifact"; detect that directly from
+  # stage 05's artifact.
+  python tools/ab_gate.py "${FUSED_AB_GATE:-docs/runs/fused_block_ab_r${RND}.json}"
+  if [ $? -eq 1 ]; then   # 1 = measured loss at stage 05 (shared rule)
+    echo "[fused_model_imagenet_ab] stage 05 measured a loss; stage 55 intentionally skipped — skipping too (negative result stands)"
+    exit 0
+  fi
+  echo "[fused_model_imagenet_ab] gate artifact $GATE missing (stage 55 unrun) — will retry next window"
+  exit 1
 fi
-python - "$GATE" <<'EOF'
-import json, sys
-try:
-    r = json.load(open(sys.argv[1]))
-    wins = [d.get("speedup", 0) > 1.0
-            for shape in r.get("by_shape", {}).values()
-            for name, d in shape.items() if isinstance(d, dict)]
-except Exception as e:
-    print(f"[fused_model_imagenet_ab] gate artifact unreadable: {e}")
-    sys.exit(2)
-if not wins:
-    print("[fused_model_imagenet_ab] gate artifact has no measured directions")
-    sys.exit(2)
-sys.exit(0 if any(wins) else 1)
-EOF
+# Shared rule (tools/ab_gate.py): 0=win, 1=measured loss, 2=torn artifact.
+python tools/ab_gate.py "$GATE"
 rc=$?
 if [ $rc -eq 1 ]; then
   echo "[fused_model_imagenet_ab] bottleneck kernel A/B shows no winning direction — skipping (negative result stands)"
@@ -38,4 +37,4 @@ elif [ $rc -eq 2 ]; then
 fi
 
 timeout -k 30 1800 python tools/fused_model_ab.py --preset imagenet \
-  --out docs/runs/fused_model_imagenet_ab_r4.json | tail -4
+  --out docs/runs/fused_model_imagenet_ab_r${RND}.json | tail -4

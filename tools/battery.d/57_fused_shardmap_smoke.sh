@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Single-real-chip shard_map smoke of the fused-Pallas dispatch (VERDICT
+# r4 item 5): proves the Mosaic-compiled kernels work inside shard_map —
+# the multi-chip story for model.fused_blocks — which the virtual-mesh
+# tests cannot (interpret-mode kernels lower to plain XLA ops there).
+# GATED like stage 55: only worth a window slice if stage 05 proved the
+# kernels compile and win; a stage-05 loss stands this down too.
+set -uo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
+cd "$REPO"
+
+GATE="${FUSED_AB_GATE:-docs/runs/fused_block_ab_r${RND}.json}"
+if [ ! -f "$GATE" ]; then
+  echo "[fused_shardmap_smoke] gate artifact $GATE missing (stage 05 not run?) — will retry next window"
+  exit 1
+fi
+python tools/ab_gate.py "$GATE"
+rc=$?
+if [ $rc -eq 1 ]; then
+  echo "[fused_shardmap_smoke] stage 05 measured a loss — skipping (fused path stands down)"
+  exit 0
+elif [ $rc -eq 2 ]; then
+  echo "[fused_shardmap_smoke] gate evaluation failed — stage will retry next window"
+  exit 1
+fi
+
+timeout -k 15 600 python tools/fused_shardmap_smoke.py \
+  --out "docs/runs/fused_shardmap_smoke_r${RND}.json" | tail -3

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# ImageNet rn18 fused basic-block model A/B (VERDICT r4 item 8): the
+# rn18/34 stages now carry VMEM-derived tile plans
+# (ops/fused_block.py::auto_batch_tile), so a stage-05 win is no longer
+# CIFAR-only — measure model.fused_blocks on/off through the rn18
+# ImageNet train step. GATED on stage 05 exactly like 55/57: a measured
+# basic-block loss stands this down; a missing/torn gate retries.
+set -uo pipefail
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
+cd "$REPO"
+
+GATE="${FUSED_AB_GATE:-docs/runs/fused_block_ab_r${RND}.json}"
+if [ ! -f "$GATE" ]; then
+  echo "[fused_imagenet_basic_ab] gate artifact $GATE missing (stage 05 not run?) — will retry next window"
+  exit 1
+fi
+python tools/ab_gate.py "$GATE"
+rc=$?
+if [ $rc -eq 1 ]; then
+  echo "[fused_imagenet_basic_ab] stage 05 measured a loss — skipping (negative result stands)"
+  exit 0
+elif [ $rc -eq 2 ]; then
+  echo "[fused_imagenet_basic_ab] gate evaluation failed — stage will retry next window"
+  exit 1
+fi
+
+timeout -k 30 1800 python tools/fused_model_ab.py --preset imagenet \
+  --resnet-size 18 \
+  --out "docs/runs/fused_imagenet_basic_ab_r${RND}.json" | tail -4

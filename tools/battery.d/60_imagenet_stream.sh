@@ -9,7 +9,8 @@
 # budget measured in r3: docs/runs/input_edge_r3.json).
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r4}"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
+OUT="${1:-$REPO/docs/runs/watch_r${RND}}"
 SHARDS=/tmp/imagenet_synth_shards
 RUN=/tmp/inet_stream_run_$$
 cd "$REPO"
@@ -38,7 +39,7 @@ timeout -k 30 1200 python -m tpu_resnet train --preset imagenet \
   train.train_steps=40 train.log_every=10 train.checkpoint_every=40 \
   train.image_summary_every=0 2>&1 | tail -20
 
-python - "$RUN" "$REPO/docs/runs/imagenet_stream_r4.json" <<'EOF'
+python - "$RUN" "$REPO/docs/runs/imagenet_stream_r${RND}.json" <<'EOF'
 import json, sys
 recs = [json.loads(l) for l in open(sys.argv[1] + "/metrics.jsonl")]
 rates = [r["steps_per_sec"] for r in recs if "steps_per_sec" in r]

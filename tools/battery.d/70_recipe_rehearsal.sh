@@ -9,8 +9,9 @@
 # the full 90k-step run is ~7 min of chip compute plus ckpt/eval overhead.
 set -euo pipefail
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r4}"
-DEST="$REPO/docs/runs/recipe_rehearsal_r4"
+RND="$(cat "$REPO/tools/BATTERY_ROUND")"
+OUT="${1:-$REPO/docs/runs/watch_r${RND}}"
+DEST="$REPO/docs/runs/recipe_rehearsal_r${RND}"
 mkdir -p "$DEST"
 cd "$REPO"
 
@@ -43,32 +44,8 @@ python -m tpu_resnet plot --dir "$RUN" \
   --out "$DEST/curves.png" --csv "$DEST/series.csv" || true
 
 # Decay-boundary evidence: the loss/precision series must show jumps at
-# the recipe steps, not just end-state accuracy.
-python - "$DEST" <<'EOF'
-import json, sys, os
-dest = sys.argv[1]
-recs = []
-for l in open(os.path.join(dest, "train_metrics.jsonl")):
-    try:  # a mid-write kill at a window close can leave a torn line
-        recs.append(json.loads(l))
-    except ValueError:
-        pass
-recs = [r for r in recs if "loss" in r]
-def win(lo, hi):
-    xs = [r["loss"] for r in recs if lo <= r["step"] <= hi]
-    return round(sum(xs) / len(xs), 4) if xs else None
-summary = {
-    "what": "freq100 oracle at the real 40k/60k/80k recipe cadence "
-            "(resnet_cifar_train.py:302-311), ckpt every 1000, live eval sidecar",
-    "steps": recs[-1]["step"] if recs else 0,
-    "loss_pre_40k": win(35000, 40000), "loss_post_40k": win(41000, 46000),
-    "loss_pre_60k": win(55000, 60000), "loss_post_60k": win(61000, 66000),
-    "loss_pre_80k": win(75000, 80000), "loss_post_80k": win(81000, 86000),
-    "final_train_precision": recs[-1].get("precision") if recs else None,
-}
-best = os.path.join(dest, "best_precision.json")
-if os.path.exists(best):
-    summary["eval_best"] = json.load(open(best))
-json.dump(summary, open(os.path.join(dest, "summary.json"), "w"), indent=2)
-print("[recipe_rehearsal]", json.dumps(summary))
-EOF
+# the recipe steps, not just end-state accuracy. Extraction shared with
+# the CPU understudy (tools/rehearsal_summary.py) — the understudy proved
+# this exact code path before chip time was spent on it.
+python tools/rehearsal_summary.py "$DEST" 40000 60000 80000 1000 \
+  --what "freq100 oracle at the real 40k/60k/80k recipe cadence (resnet_cifar_train.py:302-311), ckpt every 1000, live eval sidecar"

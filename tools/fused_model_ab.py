@@ -75,7 +75,7 @@ def main() -> int:
                 mesh, plans, resnet_size=args.resnet_size,
                 batch=args.batch, split=args.split,
                 mutate_cfg=mutate)[args.steps_per_call]
-        arms[name] = round(sps, 2)
+        arms[name] = sps
         print(f"[fused_model_ab] {name}: {sps:.2f} st/s", flush=True)
 
     what_cifar = ("model.fused_blocks A/B through the headline resident "
@@ -84,15 +84,20 @@ def main() -> int:
     what_imagenet = ("model.fused_blocks A/B through the ImageNet train "
                      f"step (fetch-synced, @{args.image} b{args.batch}, "
                      "FusedBottleneckBlock dispatch)")
+    # Ratios from the UNROUNDED rates, with zero guards: a degenerate
+    # measurement (0.0 steps/s) must record an artifact, not crash the
+    # battery stage with ZeroDivisionError (ADVICE r4).
     out = {
         "what": what_imagenet if args.preset == "imagenet" else what_cifar,
         "preset": args.preset,
         "resnet_size": args.resnet_size or 50,
         "batch": args.batch,
-        "steps_per_sec": arms,
-        "fused_speedup": round(arms["fused"] / arms["xla"], 3),
-        "fused_wins": arms["fused"] > arms["xla"],
-        "ms_per_step": {k: round(1000.0 / v, 3) for k, v in arms.items()},
+        "steps_per_sec": {k: round(v, 2) for k, v in arms.items()},
+        "fused_speedup": (round(arms["fused"] / arms["xla"], 3)
+                          if arms["xla"] > 0 else None),
+        "fused_wins": arms["fused"] > arms["xla"] > 0,
+        "ms_per_step": {k: (round(1000.0 / v, 3) if v > 0 else None)
+                        for k, v in arms.items()},
     }
     print(json.dumps(out))
     if args.out:

@@ -1,0 +1,168 @@
+"""Compile-smoke prelude for the fused-kernel battery stages (VERDICT r4
+item 3): one tiny NON-INTERPRET Pallas compile+run per kernel direction
+on the live chip, before the 1800 s A/B commits the window.
+
+Rationale: both fused families are oracle-tested in interpret mode only
+(on CPU, Pallas lowers to ordinary XLA ops), so the first live window is
+the kernels' first real Mosaic compile — a lowering error or VMEM-plan
+miscalculation inside the A/B would burn the decisive window. This
+prelude fails in ~a minute instead, writing the error as an artifact the
+gates (tools/ab_gate.py) read as a measured infeasibility, and the
+battery falls through to the headline bench.
+
+    python tools/pallas_compile_smoke.py --family block --out s.json
+    python tools/pallas_compile_smoke.py --family bottleneck --out s.json
+
+Exit codes: 0 = all directions compiled and matched the oracle;
+1 = a compile/runtime/accuracy failure (captured in --out). A hang is
+the caller's ``timeout`` to kill (stage treats 124 as tunnel flake →
+retry, not infeasibility).
+
+``--interpret`` forces interpret mode so the harness itself is testable
+on CPU (tests/test_compile_smoke.py); without it the kernels compile for
+the ambient backend — the entire point on a live chip.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_TOL = 2e-2   # bf16-accumulation-friendly oracle tolerance
+
+
+def _err(a, b):
+    import numpy as np
+    return float(np.max(np.abs(np.asarray(a, dtype="float32")
+                               - np.asarray(b, dtype="float32"))))
+
+
+def _smoke_block(interpret):
+    """Tiny basic-block shapes: fwd, custom-VJP bwd, train fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet.ops import fused_block as fb
+
+    k = jax.random.PRNGKey(0)
+    b, h, c = 8, 8, 32
+    ks = jax.random.split(k, 8)
+    x = jax.random.normal(ks[0], (b, h, h, c), jnp.float32)
+    w1 = jax.random.normal(ks[1], (3, 3, c, c), jnp.float32) * 0.1
+    w2 = jax.random.normal(ks[2], (3, 3, c, c), jnp.float32) * 0.1
+    s1, b1 = jnp.ones((c,)), jnp.zeros((c,))
+    s2, b2 = jnp.ones((c,)) * 0.5, jnp.zeros((c,)) + 0.1
+    g1, be1 = jnp.ones((c,)), jnp.zeros((c,))
+    g2, be2 = jnp.ones((c,)), jnp.zeros((c,))
+    checks = {}
+
+    y = fb.block_fwd(x, w1, w2, s1, b1, s2, b2, batch_tile=b,
+                     interpret=interpret)
+    y_ref = fb.block_fwd_reference(x, w1, w2, s1, b1, s2, b2)
+    checks["fwd_max_err"] = _err(y, y_ref)
+
+    def loss(args, f):
+        return jnp.sum(f(*args) ** 2)
+
+    args = (x, w1, w2, s1, b1, s2, b2)
+    g = jax.grad(lambda a: loss(
+        a, lambda *t: fb.block_apply(*t, batch_tile=b,
+                                     interpret=interpret)))(args)
+    g_ref = jax.grad(lambda a: loss(a, fb.block_fwd_reference))(args)
+    checks["bwd_max_err"] = max(_err(gi, ri) for gi, ri in zip(g, g_ref))
+
+    targs = (x, w1, w2, g1, be1, g2, be2)
+    yt, moments = fb.block_train_apply(*targs, batch_tile=b,
+                                       interpret=interpret)
+    yt_ref, _ = fb.block_train_fwd_reference(*targs)
+    checks["train_fwd_max_err"] = _err(yt, yt_ref)
+    gt = jax.grad(lambda a: jnp.sum(
+        fb.block_train_apply(*a, batch_tile=b,
+                             interpret=interpret)[0] ** 2))(targs)
+    gt_ref = jax.grad(lambda a: jnp.sum(
+        fb.block_train_fwd_reference(*a)[0] ** 2))(targs)
+    checks["train_bwd_max_err"] = max(
+        _err(gi, ri) for gi, ri in zip(gt, gt_ref))
+    return checks
+
+
+def _smoke_bottleneck(interpret):
+    """Tiny halo-tiled bottleneck at f=64 geometry: fwd + custom-VJP bwd."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet.ops import fused_bottleneck as fbn
+
+    k = jax.random.PRNGKey(1)
+    b, h, f = 1, 14, 64
+    c4 = 4 * f
+    ks = jax.random.split(k, 4)
+    x = jax.random.normal(ks[0], (b, h, h, c4), jnp.float32)
+    w1 = jax.random.normal(ks[1], (c4, f), jnp.float32) * 0.05
+    w2 = jax.random.normal(ks[2], (3, 3, f, f), jnp.float32) * 0.05
+    w3 = jax.random.normal(ks[3], (f, c4), jnp.float32) * 0.05
+    s1, b1 = jnp.ones((c4,)), jnp.zeros((c4,))
+    s2, b2 = jnp.ones((f,)) * 0.5, jnp.zeros((f,))
+    s3, b3 = jnp.ones((f,)), jnp.zeros((f,)) + 0.1
+    args = (x, w1, w2, w3, s1, b1, s2, b2, s3, b3)
+    checks = {}
+
+    y = fbn.bottleneck_fwd(*args, batch_tile=1, row_tile=h,
+                           interpret=interpret)
+    y_ref = fbn.bottleneck_fwd_reference(*args)
+    checks["fwd_max_err"] = _err(y, y_ref)
+
+    g = jax.grad(lambda a: jnp.sum(fbn.bottleneck_apply(
+        *a, batch_tile=1, row_tile=h, interpret=interpret) ** 2))(args)
+    g_ref = jax.grad(lambda a: jnp.sum(
+        fbn.bottleneck_fwd_reference(*a) ** 2))(args)
+    checks["bwd_max_err"] = max(_err(gi, ri) for gi, ri in zip(g, g_ref))
+    return checks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--family", choices=("block", "bottleneck"),
+                    required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--interpret", action="store_true",
+                    help="force interpret mode (CPU harness test)")
+    ns = ap.parse_args(argv)
+
+    t0 = time.time()
+    art = {"family": ns.family, "interpret": bool(ns.interpret)}
+    interpret = True if ns.interpret else False
+    try:
+        import jax
+        art["backend"] = jax.default_backend()
+        checks = (_smoke_block if ns.family == "block"
+                  else _smoke_bottleneck)(interpret)
+        art["checks"] = {k: round(v, 6) for k, v in checks.items()}
+        worst = max(checks.values())
+        art["compile_ok"] = worst < _TOL
+        if not art["compile_ok"]:
+            art["error"] = f"oracle mismatch: max_err={worst:.4g} > {_TOL}"
+    except Exception:
+        art["compile_ok"] = False
+        art["error"] = traceback.format_exc()[-2000:]
+    art["elapsed_s"] = round(time.time() - t0, 1)
+    # Gate compatibility: tools/ab_gate.py reads compile_ok=false as a
+    # measured infeasibility (loss) when this artifact replaces an A/B's.
+    art.setdefault("by_shape", {})
+    with open(ns.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"[compile_smoke] {ns.family}: "
+          f"{'OK' if art['compile_ok'] else 'FAIL'} "
+          f"({art['elapsed_s']}s, backend={art.get('backend')})")
+    if not art["compile_ok"]:
+        print(art["error"].splitlines()[-1] if art.get("error") else "")
+    return 0 if art["compile_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Shared decay-boundary extraction for the recipe rehearsals (battery
+stage 70 on the live chip; tools/recipe_rehearsal_understudy.sh on CPU —
+VERDICT r4 item 6). One source so the compressed understudy proves the
+exact extraction the full-cadence run will use.
+
+    python tools/rehearsal_summary.py DEST B1 B2 B3 WINDOW [--what TEXT]
+                                      [--resume-proven]
+
+Reads DEST/train_metrics.jsonl (+ optional DEST/best_precision.json),
+writes DEST/summary.json. For each boundary B the evidence windows are
+pre = [B-5*WINDOW, B] and post = [B+WINDOW, B+6*WINDOW] — at the real
+cadence (WINDOW=1000, boundaries 40k/60k/80k per reference
+resnet_cifar_train.py:302-311) that reproduces the round-3 stage-70
+windows exactly.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def summarize(dest, boundaries, window, what, resume_proven=None):
+    recs = []
+    for line in open(os.path.join(dest, "train_metrics.jsonl")):
+        try:  # a mid-write kill at a window close can leave a torn line
+            recs.append(json.loads(line))
+        except ValueError:
+            pass
+    recs = [r for r in recs if "loss" in r]
+
+    def win(lo, hi):
+        xs = [r["loss"] for r in recs if lo <= r["step"] <= hi]
+        return round(sum(xs) / len(xs), 4) if xs else None
+
+    summary = {
+        "what": what,
+        "steps": recs[-1]["step"] if recs else 0,
+        "boundaries": list(boundaries),
+        "final_train_precision": recs[-1].get("precision") if recs else None,
+    }
+    for b in boundaries:
+        summary[f"loss_pre_{b}"] = win(b - 5 * window, b)
+        summary[f"loss_post_{b}"] = win(b + window, b + 6 * window)
+    # The decay signature: loss drops (or at minimum does not rise) across
+    # each boundary the run actually reached.
+    drops = []
+    for b in boundaries:
+        pre, post = summary[f"loss_pre_{b}"], summary[f"loss_post_{b}"]
+        if pre is not None and post is not None:
+            drops.append(post < pre)
+    summary["boundaries_reached"] = len(drops)
+    summary["loss_dropped_at_each_boundary"] = (all(drops) if drops
+                                                else None)
+    if resume_proven is not None:
+        summary["resume_proven"] = resume_proven
+    best = os.path.join(dest, "best_precision.json")
+    if os.path.exists(best):
+        summary["eval_best"] = json.load(open(best))
+    with open(os.path.join(dest, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("dest")
+    ap.add_argument("boundaries", nargs=3, type=int)
+    ap.add_argument("window", type=int)
+    ap.add_argument("--what", default="recipe rehearsal")
+    ap.add_argument("--resume-proven", action="store_true", default=None)
+    ns = ap.parse_args(argv)
+    summary = summarize(ns.dest, ns.boundaries, ns.window, ns.what,
+                        ns.resume_proven)
+    print("[rehearsal_summary]", json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

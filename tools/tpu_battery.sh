@@ -15,7 +15,7 @@
 # (exactly how the r3 stage-20 OOM slipped through on the first window).
 set -u -o pipefail
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
-OUT="${1:-$REPO/docs/runs/watch_r4}"
+OUT="${1:-$REPO/docs/runs/watch_r$(cat "$REPO/tools/BATTERY_ROUND")}"
 RUNS="$REPO/docs/runs"
 mkdir -p "$OUT" "$RUNS"
 cd "$REPO"

@@ -237,15 +237,18 @@ class FusedBuildingBlock(nn.Module):
     EMA exactly like nn.BatchNorm (momentum 0.997). Eval folds the running
     stats to scale/bias and uses ``block_apply``.
 
-    BN-semantics caveat: batch moments are taken over the batch the kernel
-    sees. Single-device (the CIFAR headline config) that equals global
-    batch BN. On the virtual 8-device mesh the fused path reproduces the
-    sync-BN XLA losses under auto-sharding (measured to 7e-7,
-    tests/test_fused_model.py::test_fused_matches_xla_on_8device_mesh) —
-    but there the interpret-mode kernels lower to ordinary XLA ops;
-    real-TPU multi-chip auto-sharding of the non-interpret Pallas custom
-    call remains unvalidated. The gate is for the measured single-chip
-    path (battery stages 05/15).
+    BN semantics: batch moments are taken over the batch the kernel sees.
+    Single-device (the CIFAR headline config) that equals global-batch BN.
+    Multi-chip, the supported dispatch is shard_map-EXPLICIT (VERDICT r4
+    item 5): ``model.sync_bn=false`` routes the step through
+    ``train.step.shard_step(per_replica_bn=True)``, so each replica's
+    kernel call gets its concrete local shard — per-replica BN, exactly
+    the reference's semantics (resnet_model.py:120-122). The train loop
+    raises on the unsupported combination (fused + sync-BN + data>1), and
+    sync-BN via ``bn_axis_name`` raises at construction. Validated by
+    dryrun path 5 (``__graft_entry__.dryrun_multichip``) and the 8-device
+    shard_map equivalence test (tests/test_fused_model.py); the
+    single-real-chip non-interpret shard_map smoke is battery stage 57.
     """
 
     filters: int
@@ -262,10 +265,16 @@ class FusedBuildingBlock(nn.Module):
         gamma2, beta2, mean2, var2 = _BNSite(f, name="bnrelu1")()
         w2 = _ConvSite(f, f, name="conv2")()
 
+        # VMEM-derived tile plan (auto_batch_tile): reproduces the
+        # measured bt=16 at the CIFAR shapes and sizes the ImageNet
+        # rn18/34 shapes (56²x64 → bt~2-3 etc.) under the same budget;
+        # config's fused_block_tile remains the cap.
+        bt = fb.auto_batch_tile(x.shape, cap=self.batch_tile)
+
         if train:
             y, (bm1, bv1, bm2, bv2) = fb.block_train_apply(
                 x, w1, w2, gamma1, beta1, gamma2, beta2,
-                _BATCH_NORM_EPSILON, self.batch_tile, None)
+                _BATCH_NORM_EPSILON, bt, None)
             if not self.is_initializing():
                 m = _BATCH_NORM_MOMENTUM  # flax EMA convention
                 mean1.value = m * mean1.value + (1 - m) * bm1
@@ -277,12 +286,22 @@ class FusedBuildingBlock(nn.Module):
                           _BATCH_NORM_EPSILON)
         s2, b2 = fb._fold(gamma2, beta2, mean2.value, var2.value,
                           _BATCH_NORM_EPSILON)
-        return fb.block_apply(x, w1, w2, s1, b1, s2, b2, self.batch_tile)
+        return fb.block_apply(x, w1, w2, s1, b1, s2, b2, bt)
 
 
 # Bottleneck widths whose fused-kernel tile plans are sized for core
 # VMEM (ops/fused_bottleneck.py::_DEFAULT_TILES); f=512 blocks stay XLA.
 _FUSED_BOTTLENECK_WIDTHS = frozenset((64, 128, 256))
+
+
+def _check_fused_bn_axis(fused_blocks: bool, bn_axis_name) -> None:
+    """Fail-loud convention (ADVICE r4): the fused kernels compute batch
+    moments per replica with no cross-device axis sync — a sync-BN
+    request combined with ``fused_blocks`` must raise, not silently
+    degrade to per-replica BN."""
+    if fused_blocks and bn_axis_name is not None:
+        raise ValueError("fused_blocks does not implement sync-BN "
+                         "(bn_axis_name); unset one of the two")
 
 
 class FusedBottleneckBlock(nn.Module):
@@ -434,9 +453,25 @@ class BlockLayer(nn.Module):
             block_cls = nn.remat(block_cls, static_argnums=(2,))
             fused_cls = nn.remat(fused_cls, static_argnums=(2,))
         # Hybrid dispatch: only the stride-1 identity blocks fuse, and
-        # bottlenecks only at widths with a VMEM-sized tile plan.
+        # only at widths with a VMEM-sized tile plan — bottlenecks per
+        # _FUSED_BOTTLENECK_WIDTHS, basic blocks per auto_batch_tile
+        # (which rejects f=512 ImageNet blocks: weights alone ~18.9 MB).
+        # The checked shape is the STAGE shape — block0 (projection/
+        # stride) runs first, so probe with its output geometry.
         fuse = self.fused and (not self.bottleneck
                                or self.filters in _FUSED_BOTTLENECK_WIDTHS)
+        if fuse and not self.bottleneck:
+            from tpu_resnet.ops.fused_block import auto_batch_tile
+            try:
+                auto_batch_tile(
+                    (x.shape[0],
+                     (x.shape[1] + self.strides - 1) // self.strides,
+                     (x.shape[2] + self.strides - 1) // self.strides,
+                     self.filters),
+                    cap=self.fused_tile)
+            except ValueError:
+                fuse = False   # no VMEM plan at this width: stay on XLA
+        _check_fused_bn_axis(fuse, self.bn_axis_name)
         x = block_cls(self.filters, self.strides, True, self.dtype,
                       self.bn_axis_name, name="block0")(x, train)
         for i in range(1, self.blocks):
@@ -540,6 +575,15 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
     else:
         raise ValueError(f"resnet_size must be 6n+2 (or 6n+4 for wide), "
                          f"got {resnet_size}")
+    if fused_blocks and width_multiplier > 1:
+        # Same guard as models.build_model (ADVICE r4: direct constructor
+        # calls must fail with the same clear message, not an obscure
+        # downstream tile error): Wide-ResNet channels (160/320/640 at
+        # WRN-28-10) put the default tile far past core VMEM, and no A/B
+        # has measured those shapes.
+        raise ValueError("fused_blocks is only measured/tiled for "
+                         "width_multiplier=1 (16/32/64-channel stages)")
+    _check_fused_bn_axis(fused_blocks, bn_axis_name)
     w = width_multiplier
     return ResNetV2(
         stage_filters=(16 * w, 32 * w, 64 * w),
@@ -580,6 +624,7 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
         raise ValueError(
             f"invalid resnet_size {resnet_size}; have {sorted(_IMAGENET_PARAMS)}")
     bottleneck, blocks = _IMAGENET_PARAMS[resnet_size]
+    _check_fused_bn_axis(fused_blocks, bn_axis_name)
     return ResNetV2(
         stage_filters=(64, 128, 256, 512),
         stage_blocks=blocks,

@@ -96,6 +96,36 @@ def _block_kernel(x_ref, w1_ref, w2_ref, s1_ref, b1_ref, s2_ref, b2_ref,
     o_ref[...] = (x + out).astype(o_ref.dtype)
 
 
+def auto_batch_tile(shape, cap: int = 16,
+                    budget_bytes: int = 10 * 2 ** 20):
+    """VMEM-derived forward batch tile for a basic-block input ``shape``
+    (B, H, W, C) — the tile plan machinery behind ImageNet rn18/34 fused
+    basic blocks (VERDICT r4 item 8), shared with the CIFAR shapes where
+    it reproduces the measured default (bt=16 at 32²x16 under the 16
+    cap).
+
+    The forward kernel's live set is ~4 fp32 spatial slabs per batch row
+    (x, pre/pad, mid, out — _block_kernel) plus both 3x3xCxC weights;
+    the budget leaves headroom under the ~16 MB core VMEM for Mosaic's
+    own buffers. Returns the largest batch divisor within cap and
+    budget, or raises if even one batch row cannot fit (f=512 ImageNet
+    blocks: weights alone are ~18.9 MB — callers keep those on XLA)."""
+    b, h, w, c = shape
+    weight_bytes = 2 * 9 * c * c * 4
+    per_row = h * w * c * 4 * 4
+    avail = budget_bytes - weight_bytes
+    if avail < per_row:
+        raise ValueError(
+            f"fused basic block does not fit VMEM at {h}x{w}x{c}: "
+            f"weights {weight_bytes / 2**20:.1f} MB + one batch row "
+            f"{per_row / 2**20:.1f} MB exceed the {budget_bytes / 2**20:.0f}"
+            f" MB plan budget — keep this width on the XLA path")
+    bt = max(1, min(cap, b, avail // per_row))
+    while b % bt:
+        bt -= 1
+    return int(bt)
+
+
 def _default_bwd_tile(batch: int, fwd_tile: int) -> int:
     """Largest divisor of ``batch`` that is <= fwd_tile // 2 (the backward
     kernels keep ~2-3x the forward's live set, and the tile must divide
