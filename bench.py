@@ -157,14 +157,17 @@ def _fetch_sync(x) -> float:
 
 def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
                    batch=128, dtype="bfloat16", split=50_000, width=None,
-                   num_classes=None, mutate_cfg=None):
+                   num_classes=None, mutate_cfg=None, breakdown_out=None):
     """Resident-path CIFAR-shaped measurement over one shared setup; model
     and optimizer come from ``preset`` (overridable for smoke tests).
 
     ``plans`` is a list of (steps_per_call, warmup_chunks, measure_chunks);
     each plan starts at an epoch boundary and must fit within one epoch
     (compile_resident_steps' no-boundary-crossing contract). Returns
-    {steps_per_call: steps/sec}."""
+    {steps_per_call: steps/sec}. ``breakdown_out`` (a dict) gains
+    ``compile_seconds`` — the fetch-synced wall time of the first dispatch
+    (trace + XLA compile + first chunk), the same number a real run
+    reports via tpu_resnet/obs/breakdown.py."""
     import jax
 
     from tpu_resnet.data import cifar as cifar_data
@@ -191,6 +194,8 @@ def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
     spe = ds.steps_per_epoch
     results = {}
     step = 0
+    first_t0 = time.perf_counter()
+    first_dispatch = True
     for k, warmup_chunks, measure_chunks in plans:
         if warmup_chunks < 1:
             raise ValueError(f"plan k={k}: warmup_chunks must be >= 1 "
@@ -205,6 +210,12 @@ def _measure_cifar(mesh, plans, preset="cifar10", resnet_size=None,
         for _ in range(warmup_chunks):
             state, metrics = run_chunk(state, step, k)
             step += k
+            if first_dispatch:
+                first_dispatch = False
+                _fetch_sync(metrics["loss"])
+                if breakdown_out is not None:
+                    breakdown_out["compile_seconds"] = round(
+                        time.perf_counter() - first_t0, 3)
         _fetch_sync(metrics["loss"])
 
         t0 = time.perf_counter()
@@ -222,9 +233,14 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
     """CIFAR through the *streaming* input edge (host batcher → staged
     superbatch transfers → fused dispatch) — the path multi-host and
     ImageNet runs use. Comparable to the same 13.94 baseline: the
-    reference's step also included its host input pipeline."""
+    reference's step also included its host input pipeline. Returns
+    ``(steps/sec, breakdown)`` where breakdown is the measured window's
+    data_wait/dispatch decomposition (tpu_resnet/obs/breakdown.py) — the
+    bench line answers "was this measurement input-bound" directly."""
     import jax
     import numpy as np
+
+    from tpu_resnet.obs import StepBreakdown
 
     from tpu_resnet import parallel
     from tpu_resnet.data import device_data, pipeline
@@ -255,14 +271,17 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
             state, metrics = run(state, gi, gl, 0, k)
         _fetch_sync(metrics["loss"])
 
+        bd = StepBreakdown()
         t0 = time.perf_counter()
         measured = 0
         for _ in range(measure_super):
-            gi, gl, k = next(it)
-            state, metrics = run(state, gi, gl, 0, k)
+            with bd.data_wait():
+                gi, gl, k = next(it)
+            with bd.dispatch():
+                state, metrics = run(state, gi, gl, 0, k)
             measured += k
         _fetch_sync(metrics["loss"])
-        return measured / (time.perf_counter() - t0)
+        return measured / (time.perf_counter() - t0), bd.interval()
     finally:
         it.close()          # drop the depth-2 staged device buffers
         host_iter.close()   # release the producer thread + host split
@@ -524,30 +543,35 @@ def run_child(kind: str) -> None:
     if kind == "cpu":
         # Reduced counts: the CPU number is a liveness fallback, not a
         # performance claim.
-        by_k = _measure_cifar(mesh, [(2, 1, 2)])
-        result["cifar"] = {"steps_per_sec": round(by_k[2], 2)}
+        bd = {}
+        by_k = _measure_cifar(mesh, [(2, 1, 2)], breakdown_out=bd)
+        result["cifar"] = {"steps_per_sec": round(by_k[2], 2), **bd}
     else:
         # The HEADLINE stays at steps_per_call=10 (comparable across
         # rounds); k=50 is reported alongside to show what more dispatch
         # fusion buys on this attachment (remote tunnels pay more per
         # dispatch). Both plans share one setup/compile cache.
-        by_k = _measure_cifar(mesh, [(10, 4, 30), (50, 2, 5)])
+        bd = {}
+        by_k = _measure_cifar(mesh, [(10, 4, 30), (50, 2, 5)],
+                              breakdown_out=bd)
         result["cifar"] = {
             "steps_per_sec": round(by_k[10], 2),
             "steps_per_call": 10,
             "by_steps_per_call": {k: round(v, 2)
                                   for k, v in by_k.items()},
+            **bd,
         }
     print(f"[bench child] cifar: {result['cifar']}", file=sys.stderr)
     snapshot()
 
     if kind == "tpu":
         try:
-            s_sps = _measure_cifar_streaming(mesh, warmup_super=2,
-                                             measure_super=12)
+            s_sps, s_bd = _measure_cifar_streaming(mesh, warmup_super=2,
+                                                   measure_super=12)
             result["cifar_streaming"] = {
                 "steps_per_sec": round(s_sps, 2),
-                "vs_baseline": round(s_sps / BASELINE_CIFAR_SPS, 2)}
+                "vs_baseline": round(s_sps / BASELINE_CIFAR_SPS, 2),
+                **s_bd}
             print(f"[bench child] cifar streaming: {s_sps:.2f} steps/s",
                   file=sys.stderr)
         except Exception as e:

@@ -15,7 +15,10 @@ import pytest
 import fused_model_ab  # noqa: E402
 
 
+@pytest.mark.slow
 def test_ab_tiny_config(tmp_path, monkeypatch):
+    """Full A/B harness (two model compiles) — battery stage 15 runs it
+    unattended; slow-tiered like its imagenet sibling below."""
     out = tmp_path / "ab.json"
     monkeypatch.setattr(sys, "argv", [
         "fused_model_ab.py", "--resnet-size", "14", "--batch", "8",

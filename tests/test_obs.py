@@ -1,0 +1,264 @@
+"""Unified observability subsystem (tpu_resnet/obs): step-time breakdown,
+event spans, run manifest, and the /metrics + /healthz telemetry server —
+the channels the reference never had (SURVEY.md §5)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_resnet import obs
+from tpu_resnet.obs.server import (
+    TelemetryRegistry,
+    TelemetryServer,
+    parse_prometheus,
+    read_telemetry_port,
+    scrape,
+)
+from tpu_resnet.obs.spans import load_spans
+
+
+# ------------------------------------------------------------- breakdown
+
+def test_breakdown_interval_decomposition():
+    bd = obs.StepBreakdown()
+    with bd.data_wait():
+        time.sleep(0.03)
+    with bd.dispatch():
+        time.sleep(0.01)
+    bd.add_device_sample(0.5, steps=10)
+    out = bd.interval()
+    assert out["data_wait_sec"] >= 0.02
+    assert 0.0 < out["data_wait_frac"] <= 1.0
+    assert out["dispatch_sec"] >= 0.005
+    assert out["device_sync_sec"] == 0.5
+    assert out["device_step_sec_sampled"] == pytest.approx(0.05)
+    assert "compile_seconds" not in out  # never known in this run
+    # interval() drains: the next interval starts from zero
+    out2 = bd.interval()
+    assert out2["data_wait_sec"] == 0.0
+    assert "device_sync_sec" not in out2
+
+
+def test_breakdown_compile_excludes_data_wait():
+    t_outer = time.perf_counter()
+    bd = obs.StepBreakdown()
+    with bd.data_wait():
+        time.sleep(0.03)
+    time.sleep(0.02)  # stands in for trace+compile+first chunk
+    # numpy pytrees pass block_until_ready untouched — no device needed
+    compile_s = bd.first_dispatch_done({"loss": np.zeros(())})
+    elapsed = time.perf_counter() - t_outer
+    assert compile_s == bd.compile_seconds
+    assert 0.015 <= compile_s <= elapsed - 0.025  # data wait excluded
+    out = bd.interval()
+    assert out["compile_seconds"] == round(compile_s, 4)
+    assert out["data_wait_sec"] == 0.0  # interval re-primed at the sync
+    # compile_seconds is a run constant: every later interval reports it
+    assert bd.interval()["compile_seconds"] == round(compile_s, 4)
+
+
+# ----------------------------------------------------------------- spans
+
+def test_span_tracer_records_and_loads(tmp_path):
+    tr = obs.SpanTracer(str(tmp_path))
+    with tr.span("eval_pass", step=5) as attrs:
+        attrs["precision"] = 0.5
+    tr.event("marker", step=7)
+    tr.close()
+    tr.close()  # idempotent
+    tr.record("after_close", 0.0, 1.0)  # no-op, not a crash
+    spans = load_spans(str(tmp_path / "events.jsonl"))
+    assert [s["span"] for s in spans] == ["eval_pass", "marker"]
+    assert spans[0]["precision"] == 0.5
+    assert spans[0]["end"] >= spans[0]["start"]
+    assert spans[0]["duration_sec"] >= 0
+    assert spans[1]["duration_sec"] == 0  # instantaneous marker
+
+
+def test_span_tracer_disabled_writes_nothing(tmp_path):
+    tr = obs.SpanTracer(str(tmp_path), enabled=False)
+    tr.event("x")
+    tr.close()
+    assert not (tmp_path / "events.jsonl").exists()
+
+
+def test_span_records_exception_and_reraises(tmp_path):
+    tr = obs.SpanTracer(str(tmp_path))
+    with pytest.raises(RuntimeError):
+        with tr.span("checkpoint_save", step=3):
+            raise RuntimeError("disk full")
+    tr.close()
+    (span,) = load_spans(str(tmp_path / "events.jsonl"))
+    assert span["step"] == 3
+    assert "RuntimeError: disk full" in span["error"]
+
+
+def test_load_spans_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text('{"span": "run", "start": 0, "end": 1}\n{"span": "to')
+    assert [s["span"] for s in load_spans(str(path))] == ["run"]
+
+
+# -------------------------------------------------------------- manifest
+
+def test_manifest_schema_and_atomic_write(tmp_path):
+    import jax
+
+    from tpu_resnet import parallel
+    from tpu_resnet.config import load_config
+
+    cfg = load_config("smoke")
+    mesh = parallel.create_mesh(cfg.mesh)
+    path = obs.write_manifest(str(tmp_path), cfg, mesh)
+    assert path == str(tmp_path / "manifest.json")
+    assert os.listdir(tmp_path) == ["manifest.json"]  # no tmp leftovers
+    with open(path) as f:
+        m = json.load(f)
+    assert m["schema"] == 1
+    assert m["config"]["train"]["train_steps"] == cfg.train.train_steps
+    assert m["mesh"]["shape"] and m["mesh"]["axis_names"]
+    assert m["devices"]["count"] == mesh.size
+    assert m["devices"]["platform"] == jax.devices()[0].platform
+    assert m["processes"] == {"count": 1, "index": 0}
+    assert m["versions"]["jax"] == jax.__version__
+    assert m["versions"]["python"]
+    assert m["hostname"] and isinstance(m["argv"], list)
+
+
+# ---------------------------------------------------------------- server
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_telemetry_server_live_scrape(tmp_path):
+    reg = TelemetryRegistry(stale_after_sec=60.0)
+    reg.heartbeat(7)
+    reg.update({"loss": 1.5, "images_per_sec": 1234.0,
+                "data_wait_frac": 0.25})
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    assert srv is not None
+    try:
+        port = read_telemetry_port(str(tmp_path))
+        assert port == srv.port  # discovery file matches the bound port
+
+        status, text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200
+        metrics = parse_prometheus(text)
+        assert metrics["tpu_resnet_step"] == 7.0
+        assert metrics["tpu_resnet_loss"] == 1.5
+        assert metrics["tpu_resnet_images_per_sec"] == 1234.0
+        assert metrics["tpu_resnet_data_wait_frac"] == 0.25
+        # pre-declared core gauges exist before any interval completes
+        assert "tpu_resnet_steps_per_sec" in metrics
+        assert "tpu_resnet_checkpoint_lag_steps" in metrics
+        assert metrics["tpu_resnet_heartbeat_age_seconds"] < 60.0
+        assert "# TYPE tpu_resnet_loss gauge" in text
+
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["ok"] is True
+        assert health["step"] == 7
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{port}/nope")
+        assert exc.value.code == 404
+
+        # the shared scrape helper (doctor + obs_scrape) sees the same
+        report = scrape(f"127.0.0.1:{port}")
+        assert report["health_status"] == 200
+        assert report["metrics"]["tpu_resnet_step"] == 7.0
+    finally:
+        srv.close()
+        srv.close()  # idempotent
+
+
+def test_healthz_stale_returns_503():
+    reg = TelemetryRegistry(stale_after_sec=0.0)  # everything is stale
+    srv = TelemetryServer(reg, 0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read().decode())["ok"] is False
+        # scrape() treats 503 as a report, not an error
+        report = scrape(f"127.0.0.1:{srv.port}")
+        assert report["health_status"] == 503
+        assert report["health"]["ok"] is False
+    finally:
+        srv.close()
+
+
+def test_maybe_start_disabled_and_bind_failure(tmp_path):
+    reg = TelemetryRegistry()
+    assert TelemetryServer.maybe_start(-1, reg) is None  # -1 = off
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        # A taken port degrades to "no telemetry", never a crashed trainer.
+        assert TelemetryServer.maybe_start(srv.port, reg) is None
+    finally:
+        srv.close()
+
+
+def test_parse_prometheus_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus("lonely_sample_without_value")
+    out = parse_prometheus("# HELP a b\n# TYPE a gauge\na 1.5\n"
+                           'b{host="x"} 2\n')
+    assert out == {"a": 1.5, "b": 2.0}
+
+
+def test_read_telemetry_port_missing(tmp_path):
+    assert read_telemetry_port(str(tmp_path)) is None
+
+
+# ------------------------------------------------- doctor + scrape tool
+
+def test_doctor_telemetry_check(tmp_path):
+    from tpu_resnet.tools import doctor
+
+    # no telemetry.json at all
+    out = doctor._check_telemetry(str(tmp_path))
+    assert out["ok"] is False and "telemetry.json" in out["error"]
+
+    reg = TelemetryRegistry(stale_after_sec=60.0)
+    reg.heartbeat(3)
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        out = doctor._check_telemetry(str(tmp_path))
+        assert out["ok"] is True
+        assert out["port"] == srv.port and out["step"] == 3
+        assert out["heartbeat_age_sec"] < 60.0
+    finally:
+        srv.close()
+    # stale telemetry.json pointing at a dead server: loud, not a hang
+    out = doctor._check_telemetry(str(tmp_path), timeout=2.0)
+    assert out["ok"] is False and "error" in out
+
+
+def test_obs_scrape_tool(tmp_path, capsys):
+    from tpu_resnet.tools import obs_scrape
+
+    reg = TelemetryRegistry(stale_after_sec=60.0)
+    reg.heartbeat(11)
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        assert obs_scrape.main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "health: ok" in out
+        assert "tpu_resnet_step" in out and "11" in out
+
+        assert obs_scrape.main(
+            ["--url", f"127.0.0.1:{srv.port}", "--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["metrics"]["tpu_resnet_step"] == 11.0
+    finally:
+        srv.close()
+    assert obs_scrape.main(["--dir", str(tmp_path / "none")]) == 2
+    assert obs_scrape.main(["--dir", str(tmp_path), "--timeout", "2"]) == 1

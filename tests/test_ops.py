@@ -72,10 +72,11 @@ def test_shard_map_per_example_over_data_axis():
     kernel shard_mapped over the batch axis must match the reference and
     differentiate correctly — this is the path that makes the Pallas xent
     reachable in the default multi-chip config (VERDICT round 1 item 6)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tpu_resnet import parallel
+
+    shard_map, kwargs = parallel.get_shard_map()
 
     mesh = parallel.create_mesh(None)
     rng = np.random.default_rng(3)
@@ -87,7 +88,7 @@ def test_shard_map_per_example_over_data_axis():
         per_ex = shard_map(
             lambda l, y: softmax_xent_per_example(l, y, interpret=True),
             mesh=mesh, in_specs=(P("data"), P("data")),
-            out_specs=P("data"), check_vma=False)(lg, labels)
+            out_specs=P("data"), **kwargs)(lg, labels)
         return jnp.mean(per_ex)
 
     got = jax.jit(mean_xent)(logits)

@@ -145,7 +145,18 @@ def test_weight_decay_changes_loss():
 
 
 def test_train_loop_end_to_end(tmp_path):
-    """Full loop: synthetic data, checkpoints written, resume continues."""
+    """Full loop: synthetic data, checkpoints written, resume continues —
+    and every observability artifact of the run exists: metrics.jsonl with
+    the step-time breakdown, events.jsonl spans, manifest.json, and a live
+    /metrics + /healthz scrape while training (tpu_resnet/obs)."""
+    import json
+    import os
+    import threading
+    import time
+    import urllib.request
+
+    from tpu_resnet.obs.server import read_telemetry_port, scrape
+    from tpu_resnet.obs.spans import load_spans
     from tpu_resnet.train import latest_step_in, train
 
     cfg = load_config("smoke")
@@ -155,17 +166,66 @@ def test_train_loop_end_to_end(tmp_path):
     cfg.train.log_every = 5
     cfg.train.image_summary_every = 5  # input-image channel (cifar_input.py:118)
     cfg.train.global_batch_size = 16
+    cfg.train.telemetry_port = 0  # ephemeral; discovered via telemetry.json
     cfg.data.train_examples  # synthetic
+
+    # Scrape the telemetry server WHILE training runs (it closes with the
+    # loop): poll for telemetry.json, then take one /metrics + /healthz.
+    scraped = {}
+
+    def _scrape_live():
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            port = read_telemetry_port(cfg.train.train_dir)
+            if port is not None:
+                try:
+                    scraped.update(scrape(f"127.0.0.1:{port}", timeout=5))
+                    return
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.02)
+
+    scraper = threading.Thread(target=_scrape_live, daemon=True)
+    scraper.start()
     state = train(cfg)
+    scraper.join(timeout=10)
     assert int(jax.device_get(state.step)) == 10
     assert latest_step_in(cfg.train.train_dir) == 10
-    import os
     assert os.path.exists(os.path.join(cfg.train.train_dir, "images",
                                        "input_images_step5.png"))
     assert os.path.exists(os.path.join(cfg.train.train_dir, "images",
                                        "input_images_step10.png"))
 
+    # Live scrape: Prometheus text parsed, heartbeat fresh.
+    assert scraped, "telemetry server was never scraped during training"
+    assert "tpu_resnet_step" in scraped["metrics"]
+    assert "tpu_resnet_images_per_sec" in scraped["metrics"]
+    assert scraped["health"]["ok"] is True
+    assert scraped["health"]["heartbeat_age_sec"] >= 0.0
+
+    # Run manifest: resolved config + topology, written once at startup.
+    with open(os.path.join(cfg.train.train_dir, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["config"]["train"]["train_steps"] == 10
+    assert manifest["devices"]["count"] >= 1
+    assert manifest["processes"]["count"] == 1
+
+    # metrics.jsonl carries the step-time breakdown on logged intervals.
+    with open(os.path.join(cfg.train.train_dir, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    assert any("data_wait_frac" in r and "compile_seconds" in r
+               for r in records)
+
     # Resume: raising train_steps continues from the checkpoint.
     cfg.train.train_steps = 14
     state = train(cfg)
     assert int(jax.device_get(state.step)) == 14
+
+    # events.jsonl timeline: both runs' spans, including the restore.
+    spans = load_spans(os.path.join(cfg.train.train_dir, "events.jsonl"))
+    kinds = {s["span"] for s in spans}
+    assert {"run", "compile", "checkpoint_save",
+            "checkpoint_restore"} <= kinds
+    run_spans = [s for s in spans if s["span"] == "run"]
+    assert [s["stop_step"] for s in run_spans] == [10, 14]
+    assert all(s["end"] >= s["start"] for s in spans)

@@ -30,10 +30,11 @@ print("[sweeps] resident by k:", out["resident_by_steps_per_call"],
 # transfer_stage sweep, streaming path
 stages = {}
 for stage in (4, 8, 16):
-    sps = bench._measure_cifar_streaming(mesh, warmup_super=2,
-                                         measure_super=10, stage=stage)
+    sps, bd = bench._measure_cifar_streaming(mesh, warmup_super=2,
+                                             measure_super=10, stage=stage)
     stages[stage] = round(sps, 2)
-    print(f"[sweeps] streaming stage={stage}: {sps:.2f} st/s", flush=True)
+    print(f"[sweeps] streaming stage={stage}: {sps:.2f} st/s "
+          f"(data wait {bd['data_wait_frac']:.0%})", flush=True)
 out["streaming_by_transfer_stage"] = stages
 
 best_resident = max(out["resident_by_steps_per_call"].values())

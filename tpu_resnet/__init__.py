@@ -24,6 +24,8 @@ Subpackages
 ``parallel``    mesh construction, sharding, collectives, multi-host init
 ``train``       train state, optimizer, schedules, jitted step, loop, hooks
 ``evaluation``  eval-once and checkpoint-polling continuous evaluator
+``obs``         step-time breakdown, event spans, run manifest, and the
+                per-host /metrics + /healthz telemetry server
 ``export``      serialized inference export (freeze_graph equivalent)
 ``tools``       checkpoint inspector, predict, FLOP/param analysis
 """

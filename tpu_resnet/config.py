@@ -250,6 +250,14 @@ class TrainConfig:
     # into <train_dir>/profile.
     profiler_port: int = 0
     profile_steps: str = ""
+    # Telemetry HTTP server (tpu_resnet/obs/server.py), one per host:
+    # /metrics (Prometheus text) + /healthz (liveness & heartbeat age).
+    # -1 = off, 0 = OS-assigned ephemeral port (recorded in
+    # <train_dir>/telemetry.json), >0 = fixed port.
+    telemetry_port: int = -1
+    # /healthz reports ok=false (HTTP 503) when the last heartbeat is
+    # older than this many seconds.
+    telemetry_stale_sec: float = 300.0
 
 
 @dataclasses.dataclass

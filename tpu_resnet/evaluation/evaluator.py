@@ -124,6 +124,10 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
 
     eval_dir = os.path.join(cfg.train.train_dir, "eval")
     metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())
+    # Eval-pass spans on the sidecar's own timeline file (the trainer owns
+    # <train_dir>/events.jsonl; the evaluator may be a separate process).
+    from tpu_resnet import obs
+    spans = obs.SpanTracer(eval_dir, enabled=parallel.is_primary())
     best_file = os.path.join(eval_dir, "best_precision.json")
     best = 0.0
     if os.path.exists(best_file):  # survive evaluator restarts (README.md:33)
@@ -141,40 +145,50 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
 
     last_seen = None
     precision = None
-    while True:
-        step = latest_step_in(cfg.train.train_dir)
-        if step is None:
-            # Checkpoint not there yet — keep polling like the reference
-            # (resnet_cifar_eval.py:100-109).
-            log.info("no checkpoint yet in %s; sleeping", cfg.train.train_dir)
+    try:
+        while True:
+            step = latest_step_in(cfg.train.train_dir)
+            if step is None:
+                # Checkpoint not there yet — keep polling like the reference
+                # (resnet_cifar_eval.py:100-109).
+                log.info("no checkpoint yet in %s; sleeping",
+                         cfg.train.train_dir)
+                if cfg.train.eval_once:
+                    return None
+                if not _wait():
+                    break
+                continue
+            if step != last_seen:
+                state = ckpt.restore(template, step=step)
+                t0 = time.perf_counter()
+                with spans.span("eval_pass", step=step) as span_attrs:
+                    precision, loss, count = run_eval_pass(cfg, state, mesh,
+                                                           eval_step_fn)
+                    span_attrs.update(precision=round(precision, 6),
+                                      examples=count)
+                dt = time.perf_counter() - t0
+                best = max(best, precision)
+                if parallel.is_primary():
+                    os.makedirs(eval_dir, exist_ok=True)
+                    with open(best_file, "w") as f:
+                        json.dump({"best_precision": best, "step": step}, f)
+                metrics.write(step, {"Precision": precision,
+                                     "Best_Precision": best,
+                                     "eval_loss": loss})
+                log.info("eval @ step %d: precision %.4f best %.4f "
+                         "loss %.4f (%.1fs, %d examples)", step, precision,
+                         best, loss, dt, count)
+                last_seen = step
             if cfg.train.eval_once:
-                return None
+                break
             if not _wait():
                 break
-            continue
-        if step != last_seen:
-            state = ckpt.restore(template, step=step)
-            t0 = time.perf_counter()
-            precision, loss, count = run_eval_pass(cfg, state, mesh,
-                                                   eval_step_fn)
-            dt = time.perf_counter() - t0
-            best = max(best, precision)
-            if parallel.is_primary():
-                os.makedirs(eval_dir, exist_ok=True)
-                with open(best_file, "w") as f:
-                    json.dump({"best_precision": best, "step": step}, f)
-            metrics.write(step, {"Precision": precision,
-                                 "Best_Precision": best,
-                                 "eval_loss": loss})
-            log.info("eval @ step %d: precision %.4f best %.4f loss %.4f "
-                     "(%.1fs, %d examples)", step, precision, best, loss,
-                     dt, count)
-            last_seen = step
-        if cfg.train.eval_once:
-            break
-        if not _wait():
-            break
-    metrics.close()
+    finally:
+        # Early returns (eval_once with no checkpoint yet) and torn-
+        # checkpoint exceptions must still release the sidecar's jsonl
+        # handles — both closers are idempotent.
+        spans.close()
+        metrics.close()
     return precision
 
 

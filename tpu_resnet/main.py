@@ -39,7 +39,7 @@ def main(argv=None):
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
         ("doctor", "environment triage: backend probe, CPU mesh smoke, "
-                   "native plane, dataset layout"),
+                   "native plane, dataset layout, run telemetry"),
     ]:
         p = sub.add_parser(name, help=help_text)
         if name not in ("fetch", "doctor"):  # these take no run config
@@ -83,6 +83,9 @@ def main(argv=None):
             p.add_argument("--dataset", default="",
                            help="with --data-dir: layout to validate")
             p.add_argument("--data-dir", default="")
+            p.add_argument("--train-dir", default="",
+                           help="running run's dir: check its telemetry "
+                                "server answers /metrics + /healthz")
             p.add_argument("--probe-timeout", type=int, default=60)
             p.add_argument("--mesh-devices", type=int, default=8)
     args = parser.parse_args(argv)
@@ -97,6 +100,7 @@ def main(argv=None):
         if args.dataset and not args.data_dir:
             parser.error("doctor --dataset requires --data-dir")
         summary = run_doctor(dataset=args.dataset, data_dir=args.data_dir,
+                             train_dir=args.train_dir,
                              probe_timeout=args.probe_timeout,
                              mesh_devices=args.mesh_devices)
         return 0 if summary["ok"] else 1

@@ -1,0 +1,54 @@
+"""Unified observability — the subsystem the reference never had.
+
+The reference's visibility into a run was three disconnected channels
+(SURVEY.md §5: TensorBoard summaries, a console LoggingTensorHook, and
+per-task log files); "is the input pipeline the bottleneck" and "which
+pod host is straggling" were answered by grepping logs, if at all. The
+MLPerf TPU-pod scaling work (arXiv:1909.09756) and the pjit TPUv4
+training report (arXiv:2204.06514) both treat per-step timing
+decomposition and pod-level health as *prerequisites* for scaling; this
+package provides them as first-class artifacts of every run:
+
+``breakdown``   StepBreakdown — where step time goes between log
+                boundaries: ``data_wait`` (blocked in ``next(data_iter)``),
+                ``dispatch`` (enqueueing the jitted chunk) and a sampled
+                device backlog, plus one-shot ``compile_seconds``.
+``spans``       SpanTracer — structured event spans (run, compile,
+                checkpoint save/restore, eval pass, profiler trace
+                window) appended to ``events.jsonl``.
+``manifest``    ``manifest.json`` — resolved config, mesh topology,
+                device kinds, process count, package version, git rev —
+                written once at startup by the primary process.
+``server``      a stdlib-only HTTP telemetry server per host exposing
+                ``/healthz`` (liveness + heartbeat age) and ``/metrics``
+                (Prometheus text) so pods can be scraped and stragglers
+                spotted without log-grepping.
+
+Importing this package stays jax-free (jax is imported lazily where a
+device sync is needed) so stdlib-only consumers — ``tools/obs_scrape.py``,
+the doctor's telemetry check — can use the scrape/parse helpers without
+pulling in a backend.
+"""
+
+from tpu_resnet.obs.breakdown import StepBreakdown
+from tpu_resnet.obs.manifest import build_manifest, write_manifest
+from tpu_resnet.obs.server import (
+    TelemetryRegistry,
+    TelemetryServer,
+    parse_prometheus,
+    read_telemetry_port,
+    scrape,
+)
+from tpu_resnet.obs.spans import SpanTracer
+
+__all__ = [
+    "StepBreakdown",
+    "SpanTracer",
+    "TelemetryRegistry",
+    "TelemetryServer",
+    "build_manifest",
+    "parse_prometheus",
+    "read_telemetry_port",
+    "scrape",
+    "write_manifest",
+]

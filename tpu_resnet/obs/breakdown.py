@@ -1,0 +1,128 @@
+"""Step-time breakdown — where wall time goes between log boundaries.
+
+The reference could only *infer* step timing from LoggingTensorHook
+timestamps (reference resnet_cifar_train.py:282-287); whether a run was
+input-bound, dispatch-bound or device-bound was guesswork. The tracker
+decomposes every logged interval into the three host-observable places
+time is spent:
+
+``data_wait``      blocked in ``next(data_iter)`` — the input edge can't
+                   keep up (the reference bounded this with 16 queue
+                   threads and never measured it, cifar_input.py:99-100).
+``dispatch``       enqueueing the jitted chunk (host→device command path;
+                   dominated by tracing only on the first call).
+``device_sync``    a *sampled* block at the interval boundary: time the
+                   host waits for the device to drain the chunks it
+                   dispatched. With async dispatch this is the device-
+                   compute backlog — ≈0 when the host is the bottleneck,
+                   ≈ device step time × interval steps when the device is.
+
+Sampling happens only at the loop's existing log/summary boundaries (the
+chunk clipper already ends a fused dispatch exactly there), so the
+breakdown never changes fusion behavior. The first dispatch — which pays
+XLA tracing + compilation — is reported separately as ``compile_seconds``
+and excluded from the first interval so throughput numbers are never
+polluted by compile time.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class StepBreakdown:
+    """Accumulates per-interval timings; ``interval()`` drains them as a
+    metrics dict merged into the run's ``metrics.jsonl`` records."""
+
+    def __init__(self):
+        self.compile_seconds: Optional[float] = None
+        self._data_wait = 0.0
+        self._dispatch = 0.0
+        self._sync: Optional[float] = None       # last boundary sample
+        self._sync_steps = 0
+        self._interval_start = time.perf_counter()
+
+    # ------------------------------------------------------------ timers
+    @contextmanager
+    def data_wait(self):
+        """Time a blocking ``next(data_iter)``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._data_wait += time.perf_counter() - t0
+
+    @contextmanager
+    def dispatch(self):
+        """Time the (normally async) dispatch of a chunk."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._dispatch += time.perf_counter() - t0
+
+    def first_dispatch_done(self, sync) -> float:
+        """Call right after the first dispatch of the run returns: blocks
+        until the chunk is ready and records ``compile_seconds`` — the
+        first-dispatch wall time (jit trace + XLA compile + the first
+        chunk's device run). Resets the interval clock so the first logged
+        interval excludes compile entirely (the throughput meter is
+        re-primed at the same point)."""
+        import jax
+
+        jax.block_until_ready(sync)
+        # Everything since construction minus time blocked on input: the
+        # dispatch call (trace + compile) plus the first chunk's device run.
+        self.compile_seconds = (time.perf_counter() - self._interval_start
+                                - self._data_wait)
+        self.reset_interval()
+        return self.compile_seconds
+
+    def add_device_sample(self, seconds: float, steps: int) -> None:
+        """Record an externally-timed boundary sync (bench harness path)."""
+        self._sync = seconds
+        self._sync_steps = max(1, steps)
+
+    def sample_device(self, sync, steps: int) -> float:
+        """Block on the newest chunk's result at an interval boundary and
+        record the wait — the sampled device-compute backlog. ``steps`` is
+        the number of steps dispatched since the last full sync."""
+        import jax
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(sync)
+        dt = time.perf_counter() - t0
+        self.add_device_sample(dt, steps)
+        return dt
+
+    # ---------------------------------------------------------- reporting
+    def reset_interval(self) -> None:
+        self._data_wait = 0.0
+        self._dispatch = 0.0
+        self._sync = None
+        self._sync_steps = 0
+        self._interval_start = time.perf_counter()
+
+    def interval(self) -> Dict[str, float]:
+        """Drain the interval accumulators into a metrics dict.
+
+        Always contains ``data_wait_sec``/``data_wait_frac``/
+        ``dispatch_sec``; ``device_sync_sec``/``device_step_sec_sampled``
+        when a boundary sample was taken; ``compile_seconds`` (a run
+        constant — the first-dispatch wall time) once it is known."""
+        wall = max(time.perf_counter() - self._interval_start, 1e-9)
+        out = {
+            "data_wait_sec": round(self._data_wait, 6),
+            "data_wait_frac": round(min(self._data_wait / wall, 1.0), 6),
+            "dispatch_sec": round(self._dispatch, 6),
+        }
+        if self._sync is not None:
+            out["device_sync_sec"] = round(self._sync, 6)
+            out["device_step_sec_sampled"] = round(
+                self._sync / self._sync_steps, 6)
+        if self.compile_seconds is not None:
+            out["compile_seconds"] = round(self.compile_seconds, 4)
+        self.reset_interval()
+        return out

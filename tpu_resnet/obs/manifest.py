@@ -1,0 +1,90 @@
+"""Run manifest — one ``manifest.json`` per run, written at startup.
+
+The reference scattered run provenance across shell scripts, flag dumps
+and whatever the operator remembered to note (SURVEY.md §2.2's results
+artifacts are bare CSVs with no config attached); reproducing a run meant
+archaeology. The manifest pins everything needed to re-run or audit:
+
+- the fully-resolved config (post-preset, post-overrides),
+- mesh topology, device kinds/counts, process count,
+- package + python + jax versions, git revision when available,
+- hostname, argv and a wall-clock timestamp.
+
+Written once by the primary process (the chief-only rule every other
+writer follows, reference resnet_cifar_train.py:337), atomically (tmp +
+rename) so a crash mid-write never leaves a torn manifest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+SCHEMA_VERSION = 1
+
+
+def _git_rev() -> Optional[str]:
+    """Best-effort git revision of the package checkout; None outside a
+    work tree (installed wheel, bundled container)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=here,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, timeout=5)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    rev = proc.stdout.strip()
+    return rev if proc.returncode == 0 and rev else None
+
+
+def build_manifest(cfg, mesh) -> dict:
+    """Assemble the manifest dict (pure; no filesystem writes)."""
+    import jax
+
+    import tpu_resnet
+
+    devices = list(mesh.devices.flat)
+    return {
+        "schema": SCHEMA_VERSION,
+        "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": cfg.to_dict(),
+        "mesh": {"shape": dict(mesh.shape),
+                 "axis_names": list(mesh.axis_names)},
+        "devices": {
+            "count": len(devices),
+            "kinds": sorted({d.device_kind for d in devices}),
+            "platform": devices[0].platform if devices else None,
+        },
+        "processes": {"count": jax.process_count(),
+                      "index": jax.process_index()},
+        "versions": {
+            "tpu_resnet": getattr(tpu_resnet, "__version__", None),
+            "python": sys.version.split()[0],
+            "jax": jax.__version__,
+        },
+        "git_rev": _git_rev(),
+        "hostname": socket.gethostname(),
+        "argv": list(sys.argv),
+    }
+
+
+def write_manifest(train_dir: str, cfg, mesh) -> Optional[str]:
+    """Write ``<train_dir>/manifest.json`` (primary process only; atomic).
+    Returns the path, or None on a non-primary process."""
+    from tpu_resnet import parallel
+
+    if not parallel.is_primary():
+        return None
+    os.makedirs(train_dir, exist_ok=True)
+    path = os.path.join(train_dir, "manifest.json")
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(build_manifest(cfg, mesh), f, indent=1, default=list)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,295 @@
+"""Per-host HTTP telemetry — ``/metrics`` (Prometheus text) + ``/healthz``.
+
+Multi-host runs of the reference could only be health-checked by tailing
+per-task log files on each node (SURVEY.md §5); a straggling or wedged
+worker was found by hand. Every training process can instead serve two
+stdlib-only endpoints:
+
+``GET /healthz``   JSON liveness: last heartbeat step, heartbeat age in
+                   seconds, ``ok`` (age under the staleness threshold).
+                   HTTP 200 when ok, 503 when stale — load balancers and
+                   ``kubectl``-style probes need no body parsing.
+``GET /metrics``   Prometheus text exposition (version 0.0.4) of the
+                   newest training gauges — step, loss, precision, lr,
+                   steps/sec, images/sec(/chip), data-wait fraction,
+                   compile seconds, checkpoint lag, heartbeat age — so a
+                   pod can be scraped and stragglers spotted by a stock
+                   Prometheus/Grafana stack without log-grepping.
+
+No third-party dependency: ``http.server`` + a thread. The bound port is
+written to ``<train_dir>/telemetry.json`` (port 0 binds an OS-assigned
+ephemeral port) so scrapers (tools/obs_scrape.py, the doctor check) can
+discover it. This module imports no jax — stdlib-only consumers can use
+``parse_prometheus``/``read_telemetry_port`` without a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+log = logging.getLogger("tpu_resnet")
+
+NAMESPACE = "tpu_resnet"
+
+# Gauges pre-declared at registry creation so every scrape — including one
+# taken during the first compile, before any log interval completed — sees
+# the full series set (Prometheus convention: series exist from process
+# start).
+CORE_GAUGES = (
+    ("step", "Current training step (host counter)"),
+    ("loss", "Training loss at the last log interval"),
+    ("precision", "Training top-1 precision at the last log interval"),
+    ("learning_rate", "Learning rate at the last log interval"),
+    ("steps_per_sec", "Training steps per second over the last interval"),
+    ("images_per_sec", "Global images per second over the last interval"),
+    ("images_per_sec_per_chip", "Per-chip images per second"),
+    ("data_wait_frac", "Fraction of interval wall time blocked on input"),
+    ("compile_seconds", "First-dispatch wall time (trace+compile+run)"),
+    ("checkpoint_lag_steps", "Steps since the last checkpoint save"),
+)
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+class TelemetryRegistry:
+    """Thread-safe gauge store shared by the training loop (writer) and
+    the HTTP server threads (readers)."""
+
+    def __init__(self, stale_after_sec: float = 300.0):
+        self.stale_after_sec = float(stale_after_sec)
+        self._lock = threading.Lock()
+        self._gauges: Dict[str, float] = {}
+        self._help: Dict[str, str] = {}
+        self._hb_wall: Optional[float] = None
+        self._hb_step: Optional[int] = None
+        self._started = time.time()
+        for name, help_text in CORE_GAUGES:
+            self.set(name, 0.0, help=help_text)
+
+    def set(self, name: str, value, help: str = "") -> None:
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        name = _sanitize(name)
+        with self._lock:
+            self._gauges[name] = value
+            if help:
+                self._help[name] = help
+
+    def update(self, scalars: Dict[str, float]) -> None:
+        for k, v in scalars.items():
+            self.set(k, v)
+
+    def heartbeat(self, step: int) -> None:
+        """Mark the trainer alive at ``step`` (call at every log point)."""
+        with self._lock:
+            self._hb_wall = time.time()
+            self._hb_step = int(step)
+            self._gauges["step"] = float(step)
+
+    def heartbeat_age(self) -> float:
+        with self._lock:
+            base = self._hb_wall if self._hb_wall is not None \
+                else self._started
+        return max(0.0, time.time() - base)
+
+    def health(self) -> dict:
+        age = self.heartbeat_age()
+        with self._lock:
+            step = self._hb_step
+        return {
+            "ok": age < self.stale_after_sec,
+            "step": step,
+            "heartbeat_age_sec": round(age, 3),
+            "stale_after_sec": self.stale_after_sec,
+            "time": time.time(),
+        }
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            gauges = dict(self._gauges)
+            helps = dict(self._help)
+        gauges["heartbeat_age_seconds"] = round(self.heartbeat_age(), 3)
+        helps.setdefault("heartbeat_age_seconds",
+                         "Seconds since the trainer's last heartbeat")
+        lines = []
+        for name in sorted(gauges):
+            full = f"{NAMESPACE}_{name}"
+            if name in helps:
+                lines.append(f"# HELP {full} {helps[name]}")
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {gauges[name]!r}")
+        return "\n".join(lines) + "\n"
+
+
+class TelemetryServer:
+    """Daemon-threaded HTTP server over a registry. ``port=0`` binds an
+    OS-assigned ephemeral port (exposed as ``self.port``)."""
+
+    def __init__(self, registry: TelemetryRegistry, port: int = 0,
+                 host: str = "0.0.0.0"):
+        self.registry = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, registry.render().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    health = registry.health()
+                    self._send(200 if health["ok"] else 503,
+                               json.dumps(health).encode(),
+                               "application/json")
+                else:
+                    self._send(404, b'{"error": "not found"}\n',
+                               "application/json")
+
+            def log_message(self, *args):  # scrapes must not spam the run log
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-resnet-telemetry",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            httpd, self._httpd = self._httpd, None
+            httpd.shutdown()
+            httpd.server_close()
+
+    @classmethod
+    def maybe_start(cls, port: int, registry: TelemetryRegistry,
+                    train_dir: Optional[str] = None
+                    ) -> Optional["TelemetryServer"]:
+        """Start a server per the config semantics: ``port < 0`` disabled,
+        ``0`` ephemeral, ``> 0`` fixed. A bind failure (port taken) logs a
+        warning and returns None — telemetry must never kill training. The
+        bound port is recorded in ``<train_dir>/telemetry.json``."""
+        if port is None or port < 0:
+            return None
+        try:
+            server = cls(registry, port)
+        except OSError as e:
+            log.warning("telemetry server failed to bind port %s: %s "
+                        "(training continues without /metrics)", port, e)
+            return None
+        log.info("telemetry server on :%d (/metrics Prometheus text, "
+                 "/healthz liveness)", server.port)
+        if train_dir:
+            # Every host runs a server, and multi-host runs often share
+            # one train_dir — a single discovery file would be clobbered
+            # by whichever host wrote last, pointing local scrapers at a
+            # port bound on a DIFFERENT machine. Each host writes its own
+            # hostname-keyed file; the bare telemetry.json is kept as the
+            # single-host/common case (written when this host is the one
+            # that would win anyway: process_index 0).
+            try:
+                import socket
+
+                os.makedirs(train_dir, exist_ok=True)
+                record = {"port": server.port, "pid": os.getpid(),
+                          "hostname": socket.gethostname(),
+                          "started_at": time.time()}
+                names = [f"telemetry-{socket.gethostname()}.json"]
+                try:
+                    import jax
+                    primary = jax.process_index() == 0
+                except Exception:
+                    primary = True
+                if primary:
+                    names.append("telemetry.json")
+                for name in names:
+                    path = os.path.join(train_dir, name)
+                    tmp = path + f".tmp{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        json.dump(record, f)
+                    os.replace(tmp, path)
+            except OSError as e:  # discovery file is best-effort
+                log.warning("could not write telemetry.json: %s", e)
+        return server
+
+
+def read_telemetry_port(train_dir: str) -> Optional[int]:
+    """Port recorded by ``TelemetryServer.maybe_start`` for this run.
+
+    Prefers this host's ``telemetry-<hostname>.json`` (shared train_dirs
+    hold one file per host; local scrapers dial 127.0.0.1 and must get the
+    port bound on THIS machine), falling back to the bare
+    ``telemetry.json`` written by the primary process."""
+    import socket
+
+    for name in (f"telemetry-{socket.gethostname()}.json",
+                 "telemetry.json"):
+        try:
+            with open(os.path.join(train_dir, name)) as f:
+                return int(json.load(f)["port"])
+        except (OSError, ValueError, KeyError):
+            continue
+    return None
+
+
+def scrape(base_url: str, timeout: float = 5.0) -> dict:
+    """One scrape of a telemetry server: GET ``/metrics`` + ``/healthz``.
+
+    ``base_url`` is ``host[:port]`` or a full http URL. Returns
+    ``{"metrics": {name: value}, "health": {...}, "health_status": int}``
+    (a 503 — stale heartbeat — is a valid report, not an error). Raises
+    OSError when the server is unreachable and ValueError on malformed
+    bodies. Stdlib-only: the doctor check and tools/obs_scrape.py share
+    this without importing a backend."""
+    import urllib.error
+    import urllib.request
+
+    base_url = base_url.rstrip("/")
+    if "://" not in base_url:
+        base_url = "http://" + base_url
+    with urllib.request.urlopen(base_url + "/metrics",
+                                timeout=timeout) as resp:
+        metrics = parse_prometheus(resp.read().decode())
+    try:
+        with urllib.request.urlopen(base_url + "/healthz",
+                                    timeout=timeout) as resp:
+            status, body = resp.status, resp.read()
+    except urllib.error.HTTPError as e:  # 503 stale: report, don't raise
+        status, body = e.code, e.read()
+    return {"metrics": metrics, "health": json.loads(body.decode()),
+            "health_status": status}
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Prometheus text → {metric_name: value}. Raises ValueError on a
+    malformed sample line (the scrape tests use this as the parser)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            raise ValueError(f"malformed sample line: {line!r}")
+        name = parts[0].split("{", 1)[0]
+        out[name] = float(parts[1])
+    return out

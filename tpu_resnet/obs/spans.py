@@ -1,0 +1,103 @@
+"""Event-span tracer — structured lifecycle events of a run.
+
+The reference's run lifecycle (compiles, checkpoint saves, eval passes)
+existed only as interleaved log lines across per-task files (SURVEY.md
+§5); reconstructing "what happened when" meant grepping timestamps. The
+tracer appends one JSON object per span to ``<dir>/events.jsonl``:
+
+    {"span": "checkpoint_save", "start": <wall>, "end": <wall>,
+     "duration_sec": 0.041, "step": 3000, "async": true}
+
+``start``/``end`` are wall-clock (``time.time()``) so spans from
+different hosts/processes can be laid on one timeline. Span kinds written
+by the framework: ``run`` (whole training loop), ``compile`` (first
+dispatch), ``checkpoint_save`` / ``checkpoint_restore``, ``eval_pass``,
+``profiler_trace`` (the jax.profiler window). The writer is append-only,
+line-buffered, idempotent on double-``close()`` and a no-op after close —
+shutdown races (daemon threads, atexit, sidecars) can never turn
+telemetry into a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from contextlib import contextmanager
+
+log = logging.getLogger("tpu_resnet")
+
+
+class SpanTracer:
+    def __init__(self, directory: str, enabled: bool = True,
+                 filename: str = "events.jsonl"):
+        self.enabled = enabled
+        self._f = None
+        if not enabled:
+            return
+        os.makedirs(directory, exist_ok=True)
+        self._f = open(os.path.join(directory, filename), "a", buffering=1)
+
+    def record(self, kind: str, start: float, end: float, **attrs) -> None:
+        """Append one finished span. Safe after ``close()`` (no-op)."""
+        if self._f is None:
+            return
+        rec = {"span": kind, "start": round(start, 6), "end": round(end, 6),
+               "duration_sec": round(end - start, 6)}
+        rec.update(attrs)
+        try:
+            self._f.write(json.dumps(rec) + "\n")
+        except ValueError:  # closed underneath us in a shutdown race
+            self._f = None
+
+    def event(self, kind: str, **attrs) -> None:
+        """Instantaneous marker (zero-duration span)."""
+        now = time.time()
+        self.record(kind, now, now, **attrs)
+
+    @contextmanager
+    def span(self, kind: str, **attrs):
+        """Time a block as a span. Yields the attrs dict so the body can
+        attach results (e.g. ``a["precision"] = p``); an exception is
+        recorded on the span and re-raised."""
+        t0 = time.time()
+        try:
+            yield attrs
+        except BaseException as e:
+            attrs.setdefault("error", f"{type(e).__name__}: {e}"[:200])
+            raise
+        finally:
+            self.record(kind, t0, time.time(), **attrs)
+
+    def close(self) -> None:
+        if self._f is not None:
+            f, self._f = self._f, None
+            try:
+                f.close()
+            except OSError:  # pragma: no cover - fs-specific
+                pass
+
+
+def load_jsonl(path: str, require_key: str):
+    """Torn-tail-tolerant jsonl reader: one dict per parseable line that
+    carries ``require_key``; partial trailing lines (live writer, crash
+    mid-write) are skipped, not errors. The single tolerance policy shared
+    by the span and metrics readers."""
+    out = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if require_key in rec:
+                out.append(rec)
+    return out
+
+
+def load_spans(path: str):
+    """``events.jsonl`` → list of span records."""
+    return load_jsonl(path, "span")

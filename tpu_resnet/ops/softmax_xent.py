@@ -178,8 +178,11 @@ def make_pallas_xent(mesh=None):
     if mesh is None or mesh.size <= 1:
         return softmax_xent_mean
 
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tpu_resnet.parallel import get_shard_map
+
+    shard_map, kwargs = get_shard_map()
 
     def mesh_xent(logits, labels, _mesh=mesh):
         # check_vma off: pallas_call's out_shape carries no vma annotation;
@@ -188,7 +191,7 @@ def make_pallas_xent(mesh=None):
         per_ex = shard_map(
             softmax_xent_per_example, mesh=_mesh,
             in_specs=(P("data"), P("data")), out_specs=P("data"),
-            check_vma=False,
+            **kwargs,
         )(logits, labels)
         return jnp.mean(per_ex)
 

@@ -2,6 +2,7 @@ from tpu_resnet.parallel.mesh import (
     batch_sharding,
     check_divisible,
     create_mesh,
+    get_shard_map,
     local_batch_size,
     replicated,
     staged_batch_sharding,
@@ -12,6 +13,7 @@ __all__ = [
     "batch_sharding",
     "check_divisible",
     "create_mesh",
+    "get_shard_map",
     "local_batch_size",
     "replicated",
     "staged_batch_sharding",

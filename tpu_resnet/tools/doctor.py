@@ -20,6 +20,9 @@ Checks:
              lazy build exactly like first use does)
   dataset    optional --data-dir layout validation (CIFAR binary names /
              ImageNet shard pattern)
+  telemetry  optional --train-dir scrape of the run's telemetry server
+             (port from <train_dir>/telemetry.json): /metrics parses as
+             Prometheus text and /healthz reports a fresh heartbeat
 """
 
 from __future__ import annotations
@@ -125,7 +128,31 @@ def _check_dataset(dataset: str, data_dir: str) -> dict:
                 "error": f"{type(e).__name__}: {e}"}
 
 
-def run_doctor(dataset: str = "", data_dir: str = "",
+def _check_telemetry(train_dir: str, timeout: float = 5.0) -> dict:
+    """Scrape the run's obs server (tpu_resnet/obs/server.py). Healthy
+    means: telemetry.json names a port, /metrics parses as Prometheus text
+    with the core ``tpu_resnet_step`` series, and /healthz reports a
+    heartbeat younger than the staleness threshold."""
+    from tpu_resnet.obs.server import read_telemetry_port, scrape
+
+    port = read_telemetry_port(train_dir)
+    if port is None:
+        return {"ok": False,
+                "error": f"no telemetry.json under {train_dir} — is the "
+                         "trainer running with train.telemetry_port >= 0?"}
+    try:
+        report = scrape(f"http://127.0.0.1:{port}", timeout=timeout)
+    except (OSError, ValueError) as e:
+        return {"ok": False, "port": port,
+                "error": f"{type(e).__name__}: {e}"}
+    health, metrics = report["health"], report["metrics"]
+    return {"ok": bool(health.get("ok")) and "tpu_resnet_step" in metrics,
+            "port": port, "step": health.get("step"),
+            "heartbeat_age_sec": health.get("heartbeat_age_sec"),
+            "series": len(metrics)}
+
+
+def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                probe_timeout: int = 60, mesh_devices: int = 8,
                stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
@@ -148,6 +175,9 @@ def run_doctor(dataset: str = "", data_dir: str = "",
     if data_dir:
         summary["dataset"] = _check_dataset(dataset or "cifar10", data_dir)
         emit("dataset", summary["dataset"])
+    if train_dir:
+        summary["telemetry"] = _check_telemetry(train_dir)
+        emit("telemetry", summary["telemetry"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

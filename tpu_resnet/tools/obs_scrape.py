@@ -1,0 +1,82 @@
+"""One-shot telemetry scrape — pretty-print a host's /metrics + /healthz.
+
+The launch scripts' answer to "is worker N alive and how fast is it
+going" without attaching to its log file:
+
+    python -m tpu_resnet.tools.obs_scrape --dir /tmp/run1
+    python -m tpu_resnet.tools.obs_scrape --url 10.0.0.7:9200
+    python -m tpu_resnet.tools.obs_scrape --dir /tmp/run1 --json
+
+``--dir`` reads the port the trainer recorded in
+``<train_dir>/telemetry.json`` (train.telemetry_port=0 binds an ephemeral
+port, so scripts can't hardcode one); ``--url`` scrapes a remote host
+directly. Stdlib-only — never imports jax, so it costs milliseconds and
+works on a machine with no accelerator stack.
+
+Exit codes: 0 healthy, 1 unreachable, 2 no telemetry.json, 3 reachable
+but stale (/healthz ok=false) — launch scripts can branch on them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_resnet.obs.server import read_telemetry_port, scrape
+
+
+def format_report(report: dict, as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps(report, indent=1, sort_keys=True)
+    health = report["health"]
+    lines = [
+        "health: {} (HTTP {})  step={}  heartbeat_age={}s".format(
+            "ok" if health.get("ok") else "STALE",
+            report["health_status"], health.get("step"),
+            health.get("heartbeat_age_sec")),
+    ]
+    for name, value in sorted(report["metrics"].items()):
+        lines.append(f"  {name:<42s} {value:g}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obs_scrape",
+        description="one-shot scrape of a tpu_resnet telemetry server")
+    ap.add_argument("--dir", default="",
+                    help="train dir: port read from its telemetry.json")
+    ap.add_argument("--url", default="",
+                    help="host[:port] or full http URL to scrape directly")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="host to combine with the --dir port")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw report as JSON")
+    args = ap.parse_args(argv)
+    if bool(args.dir) == bool(args.url):
+        ap.error("exactly one of --dir / --url is required")
+
+    if args.dir:
+        port = read_telemetry_port(args.dir)
+        if port is None:
+            print(f"no telemetry.json under {args.dir} — is the trainer "
+                  "running with train.telemetry_port >= 0?",
+                  file=sys.stderr)
+            return 2
+        url = f"http://{args.host}:{port}"
+    else:
+        url = args.url
+    try:
+        report = scrape(url, timeout=args.timeout)
+    except (OSError, ValueError) as e:
+        print(f"scrape {url} failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+    print(format_report(report, as_json=args.json))
+    return 0 if report["health"].get("ok") else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,32 +8,24 @@ Best_Precision curves; `ps1workers1.csv` collects run series).
 Reads ``<dir>/metrics.jsonl`` (train series: loss/precision/lr/steps_per_sec,
 written by train/metrics_io.py) and, when present,
 ``<dir>/eval/metrics.jsonl`` (Precision/Best_Precision vs restored step from
-the eval sidecar) and renders one PNG. Also exports the merged series as CSV
-with ``--csv`` (the ps1workers1.csv role).
+the eval sidecar) and renders one PNG: precision, loss, throughput, and the
+step-time breakdown (data-wait fraction + sampled device step time from
+tpu_resnet/obs/breakdown.py — the "are we input-bound" panel). Also exports
+the merged series as CSV with ``--csv`` (the ps1workers1.csv role).
 """
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, List, Optional
 
 
 def load_series(path: str) -> List[dict]:
-    """metrics.jsonl → list of records (torn tail lines skipped, matching
-    evaluation/evaluator.py::_last_eval's tolerance)."""
-    out = []
-    if not os.path.exists(path):
-        return out
-    with open(path) as f:
-        for line in f:
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if "step" in rec:
-                out.append(rec)
-    return out
+    """metrics.jsonl → list of records (torn tail lines skipped; the
+    tolerance policy lives in obs/spans.py::load_jsonl)."""
+    from tpu_resnet.obs.spans import load_jsonl
+
+    return load_jsonl(path, "step")
 
 
 def _column(series: List[dict], key: str):
@@ -74,7 +66,7 @@ def plot(train_dir: str, out: Optional[str] = None,
     if csv_out:
         write_csv(train, evals, csv_out)
 
-    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    fig, axes = plt.subplots(1, 4, figsize=(20, 4))
     ax = axes[0]
     for key, label in [("precision", "train precision"),
                        ("Precision", None)]:
@@ -100,7 +92,8 @@ def plot(train_dir: str, out: Optional[str] = None,
             ax.plot(xs, ys, label=label)
     ax.set_xlabel("step")
     ax.set_title("loss")
-    ax.legend()
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend()
     ax.grid(alpha=0.3)
 
     ax = axes[2]
@@ -110,7 +103,33 @@ def plot(train_dir: str, out: Optional[str] = None,
             ax.plot(xs, ys, label=key)
     ax.set_xlabel("step")
     ax.set_title("throughput")
-    ax.legend()
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend()
+    ax.grid(alpha=0.3)
+
+    ax = axes[3]
+    xs, ys = _column(train, "data_wait_frac")
+    if xs:
+        ax.plot(xs, [100 * y for y in ys], label="data wait %",
+                color="tab:red")
+    ax2 = ax.twinx()
+    xs2, ys2 = _column(train, "device_step_sec_sampled")
+    if xs2:
+        ax2.plot(xs2, [1e3 * y for y in ys2], linestyle="--",
+                 color="tab:orange", label="device step ms (sampled)")
+        ax2.set_ylabel("ms")
+    ax.set_xlabel("step")
+    ax.set_ylim(0, 102)
+    title = "step-time breakdown"
+    compile_s = next((r["compile_seconds"] for r in train
+                      if "compile_seconds" in r), None)
+    if compile_s is not None:
+        title += f" (compile {compile_s:.1f}s)"
+    ax.set_title(title)
+    h1, l1 = ax.get_legend_handles_labels()
+    h2, l2 = ax2.get_legend_handles_labels()
+    if h1 or h2:
+        ax.legend(h1 + h2, l1 + l2, loc="upper right")
     ax.grid(alpha=0.3)
 
     fig.tight_layout()

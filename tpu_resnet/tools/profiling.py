@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -64,10 +65,14 @@ class StepTracer:
     fused multi-step dispatches never straddle the trace window.
     """
 
-    def __init__(self, train_dir: str, spec: str = ""):
+    def __init__(self, train_dir: str, spec: str = "", spans=None):
+        """``spans`` (an ``obs.SpanTracer``) gets a ``profiler_trace`` span
+        on the run timeline for every captured window."""
         self.window = parse_window(spec)
         self.dir = os.path.join(train_dir, "profile")
         self._active = False
+        self._spans = spans
+        self._t0 = None
 
     def boundaries(self) -> Tuple[int, ...]:
         return self.window or ()
@@ -78,20 +83,30 @@ class StepTracer:
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
+            self._t0 = time.time()
             log.info("profiler: tracing steps %d..%d into %s",
                      self.window[0], self.window[1], self.dir)
 
-    def after(self, step: int, sync=None) -> None:
+    def _stop(self, sync) -> None:
+        if sync is not None:  # drain async dispatches so the device
+            jax.block_until_ready(sync)  # work lands inside the trace
+        jax.profiler.stop_trace()
+        self._active = False
+        if self._spans is not None:
+            self._spans.record("profiler_trace", self._t0, time.time(),
+                               start_step=self.window[0],
+                               stop_step=self.window[1], dir=self.dir)
+
+    def after(self, step: int, sync=None) -> bool:
+        """Returns True when this call closed the trace window — it then
+        fully drained the device (the caller's device-backlog sampler
+        should treat ``step`` as its new sync point)."""
         if self._active and step >= self.window[1]:
-            if sync is not None:  # drain async dispatches so the device
-                jax.block_until_ready(sync)  # work lands inside the trace
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop(sync)
             log.info("profiler: trace written to %s", self.dir)
+            return sync is not None
+        return False
 
     def close(self, sync=None) -> None:
         if self._active:  # training ended inside the window
-            if sync is not None:
-                jax.block_until_ready(sync)
-            jax.profiler.stop_trace()
-            self._active = False
+            self._stop(sync)

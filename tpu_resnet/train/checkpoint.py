@@ -21,8 +21,11 @@ import orbax.checkpoint as ocp
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 5):
+    def __init__(self, directory: str, keep: int = 5, spans=None):
+        """``spans`` (an ``obs.SpanTracer``) records checkpoint_save /
+        checkpoint_restore spans on the run's events.jsonl timeline."""
         self.directory = os.path.abspath(directory)
+        self._spans = spans
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -34,19 +37,35 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state, force: bool = False) -> bool:
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+        import time
+
+        t0 = time.time()
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+        if saved and self._spans is not None:
+            # Async checkpointing: the span covers the blocking enqueue
+            # (serialization handoff), not the background write.
+            self._spans.record("checkpoint_save", t0, time.time(),
+                               step=int(step), **{"async": True})
+        return saved
 
     def restore(self, state_template, step: Optional[int] = None):
         """Restore into the structure/shardings of ``state_template``."""
+        import time
+
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_template)
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(abstract))
+        t0 = time.time()
+        restored = self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore(abstract))
+        if self._spans is not None:
+            self._spans.record("checkpoint_restore", t0, time.time(),
+                               step=int(step))
+        return restored
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

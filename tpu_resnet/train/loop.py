@@ -17,13 +17,14 @@ async-PS, Horovod — SURVEY.md §2.3): the mesh decides the distribution.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_resnet import parallel
+from tpu_resnet import obs, parallel
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.data import device_data
@@ -120,7 +121,21 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     state = jax.device_put(state, parallel.replicated(mesh))
     n_params = param_count(state.params)
 
-    ckpt = CheckpointManager(cfg.train.train_dir, keep=cfg.train.keep_checkpoints)
+    # Observability (tpu_resnet/obs): event spans + run manifest + the
+    # per-host telemetry server. Spans/manifest are primary-only like
+    # every other writer; the HTTP server runs on EVERY host so a pod can
+    # be scraped for stragglers.
+    spans = obs.SpanTracer(cfg.train.train_dir,
+                           enabled=parallel.is_primary())
+    obs.write_manifest(cfg.train.train_dir, cfg, mesh)
+    telemetry = obs.TelemetryRegistry(
+        stale_after_sec=cfg.train.telemetry_stale_sec)
+    telemetry.heartbeat(0)  # alive from startup; re-fired with the real
+    server = obs.TelemetryServer.maybe_start(  # step once state is known
+        cfg.train.telemetry_port, telemetry, train_dir=cfg.train.train_dir)
+
+    ckpt = CheckpointManager(cfg.train.train_dir,
+                             keep=cfg.train.keep_checkpoints, spans=spans)
     latest = ckpt.latest_step()
     if latest is not None:
         state = ckpt.restore(state)
@@ -190,10 +205,23 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
 
     profiling.maybe_start_server(cfg.train.profiler_port)
     tracer = profiling.StepTracer(cfg.train.train_dir,
-                                  cfg.train.profile_steps)
+                                  cfg.train.profile_steps, spans=spans)
+
+    # Step-time breakdown (tpu_resnet/obs/breakdown.py): data_wait /
+    # dispatch / sampled device backlog per log interval, compile time of
+    # the first dispatch reported separately. Sampling reuses the existing
+    # log boundaries (chunks already end exactly there), so it never
+    # changes fusion behavior.
+    breakdown = obs.StepBreakdown()
+    telemetry.heartbeat(step)
+    run_wall0 = time.time()
+    start_step = step
+    last_ckpt_step = step  # resumed or fresh: the last synced point
+    first_dispatch = True
 
     meter.rate(step)
     last_summary = step
+    last_sync = step  # last step the host fully drained the device at
     m = None  # metrics of the newest dispatched chunk
     stage_buf = None  # current streaming superbatch: (gi, gl, k, offset)
     # Raw input images for the image-summary channel (reference
@@ -201,60 +229,120 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     # streamed batch; augmented at write time so the summary shows what
     # the model actually saw.
     last_inputs = images_np[:4] if resident else None
-    while step < total:
-        tracer.before(step)
-        if resident:
-            k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch,
-                           tracer.boundaries())
-            state, m = run_chunk(state, step, k)
-            step += k
-        elif stage > 1:
-            if stage_buf is None:
-                gi, gl, k = next(data_iter)
-                stage_buf = (gi, gl, k, 0)
-            gi, gl, k, off = stage_buf
-            # Fuse up to the stage end, clipped to the next log/summary/
-            # checkpoint/trace boundary so every hook fires at the exact
-            # steps a one-dispatch-per-step loop would fire it.
-            c = min(k - off,
-                    _chunk_len(step, total, cfg.train, 0,
-                               tracer.boundaries()))
-            state, m = run_staged(state, gi, gl, off, c)
-            step += c
-            off += c
-            last_inputs = gi  # reference only; sliced at summary time
-            stage_buf = None if off >= k else (gi, gl, k, off)
-        else:
-            images, labels = next(data_iter)
-            state, m = train_step(state, images, labels)
-            step += 1
-            last_inputs = images
-        tracer.after(step, sync=m)
+    try:
+        while step < total:
+            tracer.before(step)
+            if resident:
+                k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch,
+                               tracer.boundaries())
+                with breakdown.dispatch():
+                    state, m = run_chunk(state, step, k)
+                step += k
+            elif stage > 1:
+                if stage_buf is None:
+                    with breakdown.data_wait():
+                        gi, gl, k = next(data_iter)
+                    stage_buf = (gi, gl, k, 0)
+                gi, gl, k, off = stage_buf
+                # Fuse up to the stage end, clipped to the next log/summary/
+                # checkpoint/trace boundary so every hook fires at the exact
+                # steps a one-dispatch-per-step loop would fire it.
+                c = min(k - off,
+                        _chunk_len(step, total, cfg.train, 0,
+                                   tracer.boundaries()))
+                with breakdown.dispatch():
+                    state, m = run_staged(state, gi, gl, off, c)
+                step += c
+                off += c
+                last_inputs = gi  # reference only; sliced at summary time
+                stage_buf = None if off >= k else (gi, gl, k, off)
+            else:
+                with breakdown.data_wait():
+                    images, labels = next(data_iter)
+                with breakdown.dispatch():
+                    state, m = train_step(state, images, labels)
+                step += 1
+                last_inputs = images
+            if tracer.after(step, sync=m):
+                # Closing a trace window drains the device mid-interval:
+                # the backlog the next boundary sample sees only covers
+                # steps dispatched since here.
+                last_sync = step
 
-        if step % cfg.train.log_every == 0 or step == total:
-            m = {k: float(v) for k, v in jax.device_get(m).items()}
-            rate = meter.rate(step)
-            if rate:
-                m.update(rate)
-            log.info("step %d | loss %.4f | precision %.4f | lr %.4g%s",
-                     step, m["loss"], m["precision"], m["learning_rate"],
-                     f" | {m['steps_per_sec']:.2f} st/s "
-                     f"({m['images_per_sec']:.0f} img/s)" if rate else "")
-            # Summaries reuse the logged measurement, tagged with the step it
-            # was measured at (never a stale value under a different step).
-            if step - last_summary >= cfg.train.summary_every or step == total:
-                metrics.write(step, m)
-                last_summary = step
-        if (cfg.train.image_summary_every > 0 and metrics.enabled
-                and last_inputs is not None
-                and step % cfg.train.image_summary_every == 0):
-            raw = _local_image_slice(last_inputs)
-            aug = augment_fn(jax.random.PRNGKey(step), jnp.asarray(raw))
-            metrics.write_images(step, jax.device_get(aug))
-        if step % cfg.train.checkpoint_every == 0 or step == total:
-            ckpt.save(step, state)
+            if first_dispatch:
+                # The first dispatch pays jit tracing + XLA compile: report
+                # it as compile_seconds and re-prime the throughput meter so
+                # the first logged images/sec excludes compile time.
+                first_dispatch = False
+                compile_s = breakdown.first_dispatch_done(m)
+                now = time.time()
+                spans.record("compile", now - compile_s, now,
+                             seconds=round(compile_s, 3), step=start_step)
+                telemetry.set("compile_seconds", compile_s)
+                meter.rate(step)
+                last_sync = step
 
-    tracer.close(sync=m)
-    ckpt.wait()
-    metrics.close()
+            if step % cfg.train.log_every == 0 or step == total:
+                breakdown.sample_device(m, step - last_sync)
+                m = {k: float(v) for k, v in jax.device_get(m).items()}
+                last_sync = step
+                rate = meter.rate(step)
+                if rate:
+                    m.update(rate)
+                m.update(breakdown.interval())
+                telemetry.update(m)
+                telemetry.set("checkpoint_lag_steps", step - last_ckpt_step)
+                telemetry.heartbeat(step)
+                log.info("step %d | loss %.4f | precision %.4f | lr %.4g%s"
+                         " | wait %d%%",
+                         step, m["loss"], m["precision"], m["learning_rate"],
+                         f" | {m['steps_per_sec']:.2f} st/s "
+                         f"({m['images_per_sec']:.0f} img/s)" if rate else "",
+                         round(m["data_wait_frac"] * 100))
+                # Summaries reuse the logged measurement, tagged with the
+                # step it was measured at (never a stale value under a
+                # different step).
+                if (step - last_summary >= cfg.train.summary_every
+                        or step == total):
+                    metrics.write(step, m)
+                    last_summary = step
+            if (cfg.train.image_summary_every > 0 and metrics.enabled
+                    and last_inputs is not None
+                    and step % cfg.train.image_summary_every == 0):
+                raw = _local_image_slice(last_inputs)
+                aug = augment_fn(jax.random.PRNGKey(step), jnp.asarray(raw))
+                metrics.write_images(step, jax.device_get(aug))
+            if step % cfg.train.checkpoint_every == 0 or step == total:
+                if ckpt.save(step, state):
+                    last_ckpt_step = step
+                    telemetry.set("checkpoint_lag_steps", 0)
+    finally:
+        # One shutdown path for clean exits AND exceptions. Each closer
+        # runs even if an earlier one raises (a failed ckpt.wait must not
+        # leave the run span unwritten or the telemetry server answering
+        # /healthz for a dead loop); a closer error surfaces on a clean
+        # exit but never masks an in-flight loop exception.
+        import sys
+
+        closer_errs = []
+
+        def _close(fn):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - shutdown must finish
+                closer_errs.append(e)
+                log.warning("shutdown closer %s failed: %s",
+                            getattr(fn, "__name__", fn), e)
+
+        _close(lambda: tracer.close(sync=m))
+        _close(ckpt.wait)
+        _close(lambda: spans.record(
+            "run", run_wall0, time.time(), start_step=start_step,
+            stop_step=step, train_steps=total))
+        _close(spans.close)
+        if server is not None:
+            _close(server.close)
+        _close(metrics.close)
+        if closer_errs and sys.exc_info()[0] is None:
+            raise closer_errs[0]
     return state

@@ -28,11 +28,14 @@ log = logging.getLogger("tpu_resnet")
 
 class MetricsWriter:
     def __init__(self, directory: str, enabled: bool = True,
-                 tensorboard: bool = True):
+                 tensorboard: bool = True, tb_flush_secs: float = 10.0):
         self.enabled = enabled
         self.directory = directory
         self._jsonl = None
         self._tb = None
+        self._tf = None  # TF module, imported once at init (not per write)
+        self._tb_flush_secs = tb_flush_secs
+        self._tb_last_flush = time.monotonic()
         if not enabled:
             return
         os.makedirs(directory, exist_ok=True)
@@ -40,24 +43,32 @@ class MetricsWriter:
                            buffering=1)
         if tensorboard:
             try:
-                from tensorflow.summary import (  # type: ignore
-                    create_file_writer)
-                self._tb = create_file_writer(directory)
+                import tensorflow as tf  # type: ignore
+                self._tf = tf
+                self._tb = tf.summary.create_file_writer(directory)
             except Exception:
                 self._tb = None
 
+    def _tb_maybe_flush(self, force: bool = False) -> None:
+        """Flush the TB event file on an interval (or at close), not on
+        every scalar write — per-write flushes serialized the whole event
+        pipeline behind the filesystem."""
+        now = time.monotonic()
+        if force or now - self._tb_last_flush >= self._tb_flush_secs:
+            self._tb.flush()
+            self._tb_last_flush = now
+
     def write(self, step: int, scalars: Dict[str, float]) -> None:
-        if not self.enabled:
+        if not self.enabled or self._jsonl is None:
             return
         rec = {"step": int(step), "wall": time.time()}
         rec.update({k: float(v) for k, v in scalars.items()})
         self._jsonl.write(json.dumps(rec) + "\n")
         if self._tb is not None:
-            import tensorflow as tf  # type: ignore
             with self._tb.as_default():
                 for k, v in scalars.items():
-                    tf.summary.scalar(k, float(v), step=int(step))
-                self._tb.flush()
+                    self._tf.summary.scalar(k, float(v), step=int(step))
+            self._tb_maybe_flush()
 
     def write_images(self, step: int, images, name: str = "input_images",
                      max_images: int = 4) -> None:
@@ -67,7 +78,7 @@ class MetricsWriter:
         each image is min-max normalized for display. Written to
         TensorBoard when available, and always as a PNG grid under
         ``<dir>/images/`` so the channel exists without TF."""
-        if not self.enabled:
+        if not self.enabled or self._jsonl is None:
             return
         import numpy as np
 
@@ -77,11 +88,10 @@ class MetricsWriter:
         imgs = ((imgs - lo) / np.maximum(hi - lo, 1e-6) * 255).astype(
             np.uint8)
         if self._tb is not None:
-            import tensorflow as tf  # type: ignore
             with self._tb.as_default():
-                tf.summary.image(name, imgs, step=int(step),
-                                 max_outputs=max_images)
-                self._tb.flush()
+                self._tf.summary.image(name, imgs, step=int(step),
+                                       max_outputs=max_images)
+            self._tb_maybe_flush()
         try:
             from PIL import Image
 
@@ -94,10 +104,23 @@ class MetricsWriter:
             pass
 
     def close(self) -> None:
+        """Idempotent: double-close and write-after-close are no-ops, so
+        shutdown races (sidecar threads, atexit, finally blocks) never die
+        on a closed-file ValueError."""
         if self._jsonl is not None:
-            self._jsonl.close()
+            jsonl, self._jsonl = self._jsonl, None
+            jsonl.close()
         if self._tb is not None:
-            self._tb.close()
+            tb, self._tb = self._tb, None
+            self._tb_maybe_flush_writer_close(tb)
+
+    @staticmethod
+    def _tb_maybe_flush_writer_close(tb) -> None:
+        try:
+            tb.flush()
+            tb.close()
+        except Exception:  # TF teardown-order quirks must not kill shutdown
+            pass
 
 
 class ThroughputMeter:

@@ -178,10 +178,11 @@ def per_replica_shard_map(fn, mesh: Mesh, in_specs):
     Outputs (state, metrics) are replicated by construction — every shard
     applies the same pmean-ed grads/stats — hence ``out_specs=P()`` with
     VMA checking off (the explicit pmeans are the replication proof)."""
-    from jax import shard_map
+    from tpu_resnet.parallel import get_shard_map
 
+    shard_map, kwargs = get_shard_map()
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=(P(), P()), check_vma=False)
+                     out_specs=(P(), P()), **kwargs)
 
 
 def shard_step(step_fn, mesh: Mesh, donate_state: bool = True,
