@@ -47,6 +47,10 @@ def test_completeness_prefers_more_sections():
     assert bench._completeness(fuller) > bench._completeness(partial)
 
 
+@pytest.mark.slow  # 19s: bench-harness WRN-path smoke; the streaming and
+# pallas A/B smokes keep the harness covered in tier-1. Joined the slow
+# tier to keep the default tier inside the 870s verify budget (precedent:
+# its imagenet/multiplan siblings above).
 def test_measure_cifar_wide_smoke(mesh):
     """The WRN entry's path: width multiplier + 100 classes."""
     by_k = bench._measure_cifar(mesh, [(2, 1, 1)], resnet_size=10,

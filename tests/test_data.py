@@ -178,6 +178,73 @@ def test_background_iterator_propagates_errors():
         next(it)
 
 
+def test_background_iterator_producer_death_raises_not_hangs(monkeypatch):
+    """A producer thread that dies without enqueueing its error (here:
+    SystemExit, which the error path deliberately doesn't catch) must
+    surface as a loud error at the consumer, not block get() forever."""
+    import time
+
+    monkeypatch.setattr(pipeline, "GET_POLL_SEC", 0.05)
+
+    def gen():
+        yield 1
+        raise SystemExit  # kills the thread outside the Exception path
+
+    it = pipeline.BackgroundIterator(gen(), capacity=2)
+    assert next(it) == 1
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="producer thread died"):
+        next(it)
+    assert time.monotonic() - t0 < 10
+    assert not it._thread.is_alive()
+
+
+def test_background_iterator_error_path_full_queue_no_deadlock(monkeypatch):
+    """Loader error with the queue full and the consumer not draining:
+    the old put(e) blocked forever; the producer must instead free a slot
+    (drain) and deliver the exception."""
+    monkeypatch.setattr(pipeline, "ERROR_PUT_TIMEOUT_SEC", 0.1)
+
+    def gen():
+        yield "only"
+        raise ValueError("boom")
+
+    it = pipeline.BackgroundIterator(gen(), capacity=1)
+    # don't consume anything: the queue is full when the error fires
+    it._thread.join(timeout=10)
+    assert not it._thread.is_alive(), "producer deadlocked on its error"
+    with pytest.raises(ValueError, match="boom"):
+        next(it)  # buffered item was dropped in favor of the error
+
+
+def test_background_iterator_external_stop_unblocks_consumer(monkeypatch):
+    """The preemption hook: with the producer stalled (alive but not
+    yielding), setting the external stop event must end iteration at the
+    consumer within ~one poll cycle — a preempted trainer blocked in
+    next(data_iter) can still save its final checkpoint in the grace
+    window."""
+    import threading
+    import time
+
+    monkeypatch.setattr(pipeline, "GET_POLL_SEC", 0.05)
+    stall = threading.Event()
+
+    def gen():
+        yield 1
+        stall.wait(30)  # a dead data source, as far as the consumer knows
+        yield 2
+
+    stop = threading.Event()
+    it = pipeline.BackgroundIterator(gen(), capacity=2, external_stop=stop)
+    assert next(it) == 1
+    threading.Timer(0.1, stop.set).start()
+    t0 = time.monotonic()
+    with pytest.raises(StopIteration):
+        next(it)
+    assert time.monotonic() - t0 < 5  # unblocked by the event, not data
+    stall.set()  # release the producer thread
+
+
 # -------------------------------------------------------------- augmentation
 def test_per_image_standardization_matches_tf_semantics():
     rng = np.random.default_rng(0)

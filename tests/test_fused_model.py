@@ -148,6 +148,10 @@ def test_training_run_matches_xla_path(tmp_path):
                                rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow  # 34s: composition of two default-OFF opt-ins
+# (model.fused_blocks × model.remat); the single-feature equivalence
+# tests above stay tier-1. Joined the slow tier to keep the default tier
+# inside the 870s verify budget (precedent: the fused A/B smokes).
 def test_fused_composes_with_remat(setup):
     """model.remat wraps FusedBuildingBlock too (nn.remat over a
     custom-VJP pallas call) — the composition must produce the same
@@ -300,6 +304,10 @@ def test_fused_matches_xla_on_8device_mesh():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # 22s: default-OFF feature (model.fused_blocks) whose
+# jit-path 8-device equivalence test stays tier-1; this shard_map variant
+# joined the slow tier to keep the default tier inside the 870s verify
+# budget (precedent: the fused A/B smokes).
 def test_fused_shardmap_matches_xla_shardmap_on_8device_mesh():
     """The shard_map-EXPLICIT fused dispatch (VERDICT r4 item 5 — the
     supported multi-chip story for model.fused_blocks): fused vs XLA

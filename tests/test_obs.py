@@ -195,6 +195,61 @@ def test_healthz_stale_returns_503():
         srv.close()
 
 
+def test_stall_visibility_heartbeat_staleness_and_ckpt_lag(tmp_path):
+    """A stalled loop is visible from outside: /healthz flips to 503 once
+    the heartbeat goes stale, /metrics keeps exposing the frozen step and
+    the checkpoint lag, and a resumed heartbeat flips it back."""
+    reg = TelemetryRegistry(stale_after_sec=0.25)
+    reg.heartbeat(7)
+    reg.set("checkpoint_lag_steps", 12)
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        status, _ = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200  # fresh heartbeat
+        time.sleep(0.4)  # the simulated loop stops heartbeating
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        health = json.loads(exc.value.read().decode())
+        assert health["ok"] is False and health["step"] == 7
+        assert health["heartbeat_age_sec"] > 0.25
+        _, text = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        metrics = parse_prometheus(text)
+        assert metrics["tpu_resnet_step"] == 7.0  # frozen, not absent
+        assert metrics["tpu_resnet_checkpoint_lag_steps"] == 12.0
+        assert metrics["tpu_resnet_heartbeat_age_seconds"] > 0.25
+        # fault counters are pre-declared (zero), not missing series
+        assert metrics["tpu_resnet_fault_watchdog_stalls"] == 0.0
+        assert metrics["tpu_resnet_fault_nan_rollbacks"] == 0.0
+        reg.heartbeat(8)  # the loop recovers
+        status, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200 and json.loads(body)["step"] == 8
+    finally:
+        srv.close()
+
+
+def test_mark_unhealthy_overrides_fresh_heartbeat():
+    """The hang watchdog's channel: /healthz must report unhealthy with
+    the stall reason even while heartbeats are technically fresh."""
+    reg = TelemetryRegistry(stale_after_sec=300.0)
+    reg.heartbeat(3)
+    reg.mark_unhealthy("no step progress for 9.3s at step 3")
+    srv = TelemetryServer(reg, 0, host="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        health = json.loads(exc.value.read().decode())
+        assert health["ok"] is False
+        assert "no step progress" in health["unhealthy_reason"]
+        reg.clear_unhealthy()
+        status, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert status == 200
+        assert "unhealthy_reason" not in json.loads(body)
+    finally:
+        srv.close()
+
+
 def test_maybe_start_disabled_and_bind_failure(tmp_path):
     reg = TelemetryRegistry()
     assert TelemetryServer.maybe_start(-1, reg) is None  # -1 = off

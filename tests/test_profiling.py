@@ -36,6 +36,10 @@ def test_chunk_len_respects_trace_window():
     assert _chunk_len(103, 1000, cfg.train, 10_000, (103, 107)) == 4
 
 
+@pytest.mark.slow  # 32s: opt-in profiler window end-to-end; the chunk/
+# window clipping invariant stays tier-1 via the pure _chunk_len test
+# above. Joined the slow tier to keep the default tier inside the 870s
+# verify budget (precedent: the fused A/B smokes).
 def test_trace_window_during_training(tmp_path):
     """A traced run writes a profile under <train_dir>/profile and the
     trace covers whole chunks (no straddle)."""

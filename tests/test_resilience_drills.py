@@ -1,0 +1,226 @@
+"""End-to-end fault drills: a real train() run survives each injected
+fault (tpu_resnet/resilience/faultinject.py) — SIGTERM → clean save +
+exact-step resume; NaN loss → rollback + bounded retry past the bad data
+window; data stall → watchdog stack dump + recovery; corrupt latest
+checkpoint → restore falls back; in-flight crash → emergency save. Slow
+tier: each drill runs (and compiles) real training; the fast policy units
+live in tests/test_resilience.py."""
+
+import glob
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_resnet import resilience
+from tpu_resnet.config import load_config
+from tpu_resnet.obs.spans import load_spans
+from tpu_resnet.train import latest_step_in, train
+
+pytestmark = pytest.mark.slow
+
+
+def _drill_cfg(tmp_path, steps=12):
+    """Tiny MLP streaming run: small enough that every drill recompiles in
+    seconds, streaming (not device-resident) so the data-fault injection
+    points are live."""
+    cfg = load_config("smoke")
+    cfg.train.train_dir = str(tmp_path / "run")
+    cfg.train.train_steps = steps
+    cfg.train.checkpoint_every = 4
+    cfg.train.log_every = 2
+    cfg.train.summary_every = 4
+    cfg.train.image_summary_every = 0
+    cfg.train.global_batch_size = 16
+    cfg.train.steps_per_call = 2
+    cfg.model.name = "mlp"
+    cfg.data.device_resident = "off"
+    cfg.data.transfer_stage = 1
+    cfg.resilience.watchdog_stall_sec = 0  # on only in the stall drill
+    return cfg
+
+
+def _spans(cfg):
+    return load_spans(os.path.join(cfg.train.train_dir, "events.jsonl"))
+
+
+def test_sigterm_drill_clean_save_and_exact_resume(tmp_path):
+    cfg = _drill_cfg(tmp_path)
+    cfg.resilience.inject_sigterm_at_step = 6
+    with pytest.raises(resilience.Preempted) as exc:
+        train(cfg)
+    assert exc.value.step == 6
+    # the forced final save means the resume loses zero steps
+    assert latest_step_in(cfg.train.train_dir) == 6
+
+    state = train(_drill_cfg(tmp_path))  # no injection: resume + finish
+    assert int(jax.device_get(state.step)) == 12
+    spans = _spans(cfg)
+    runs = [(s["start_step"], s["stop_step"]) for s in spans
+            if s["span"] == "run"]
+    assert runs == [(0, 6), (6, 12)]  # exact step stream, no gap/replay
+    assert any(s["span"] == "preempt_stop" and s["step"] == 6
+               for s in spans)
+
+
+def test_nan_drill_rollback_and_retry_past_bad_window(tmp_path):
+    cfg = _drill_cfg(tmp_path)
+    cfg.resilience.inject_nan_at_step = 5  # poisons the step-5 batch
+    state = train(cfg)
+    assert int(jax.device_get(state.step)) == 12
+
+    spans = _spans(cfg)
+    (rb,) = [s for s in spans if s["span"] == "nan_rollback"]
+    # NaN lands in the loss at step 6 (first log boundary after the batch),
+    # rollback restores checkpoint step 4
+    assert rb["from_step"] == 6 and rb["to_step"] == 4
+    assert rb["retry"] == 1
+    # the run recovered: final logged loss is finite
+    with open(os.path.join(cfg.train.train_dir, "metrics.jsonl")) as f:
+        records = [json.loads(line) for line in f]
+    finals = [r for r in records if "loss" in r]
+    assert finals and np.isfinite(finals[-1]["loss"])
+    assert finals[-1]["step"] == 12
+
+
+def test_nan_drill_without_checkpoint_fails_loudly(tmp_path):
+    cfg = _drill_cfg(tmp_path)
+    cfg.train.checkpoint_every = 100  # nothing saved before the NaN
+    cfg.resilience.inject_nan_at_step = 5
+    with pytest.raises(resilience.DivergenceError, match="no checkpoint"):
+        train(cfg)
+
+
+def test_stall_drill_watchdog_fires_and_stream_recovers(tmp_path):
+    cfg = _drill_cfg(tmp_path)
+    cfg.resilience.watchdog_stall_sec = 0.6
+    cfg.resilience.inject_stall_at_step = 6
+    # Long enough that the loop is provably blocked after compile and the
+    # prefetch buffers drain (the producer sleeps while the loop runs on).
+    cfg.resilience.inject_stall_seconds = 6.0
+    state = train(cfg)
+    assert int(jax.device_get(state.step)) == 12  # stream recovered
+
+    spans = _spans(cfg)
+    stalls = [s for s in spans if s["span"] == "watchdog_stall"]
+    assert stalls, "watchdog never fired during the injected stall"
+    assert os.path.exists(stalls[0]["stack_dump"])
+    content = open(stalls[0]["stack_dump"]).read()
+    assert "MainThread" in content  # the blocked loop's stack is in there
+    # progress resumed → the unhealthy mark was cleared
+    assert any(s["span"] == "watchdog_recovered" for s in spans)
+
+
+def test_sigterm_during_data_stall_still_saves(tmp_path):
+    """Preemption arriving while the loop is BLOCKED in next(data_iter)
+    on a stalled producer (the compound failure preemptible pods actually
+    see) must still complete the graceful stop inside the grace window:
+    the external-stop hook unblocks the consumer and the final save
+    lands."""
+    import threading
+    import time
+
+    cfg = _drill_cfg(tmp_path)
+    cfg.resilience.inject_stall_at_step = 6
+    cfg.resilience.inject_stall_seconds = 60.0  # far beyond any timeout
+    # deliver SIGTERM once the loop is provably inside the stall window
+    threading.Timer(8.0, os.kill,
+                    args=(os.getpid(), __import__("signal").SIGTERM)).start()
+    t0 = time.monotonic()
+    with pytest.raises(resilience.Preempted) as exc:
+        train(cfg)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 45, f"graceful stop took {elapsed:.0f}s — the " \
+                         "consumer never unblocked from the stalled source"
+    assert exc.value.step >= 4
+    assert latest_step_in(cfg.train.train_dir) == exc.value.step
+
+
+def test_nan_at_checkpoint_only_boundary_never_persisted(tmp_path):
+    """checkpoint_every not a multiple of log_every: a checkpoint-only
+    boundary between log checks must not persist NaN state (it would
+    become the rollback target)."""
+    cfg = _drill_cfg(tmp_path)
+    cfg.train.checkpoint_every = 2
+    cfg.train.log_every = 4
+    cfg.train.summary_every = 4
+    cfg.resilience.inject_nan_at_step = 5  # NaN state from step 6 on
+    state = train(cfg)
+    assert int(jax.device_get(state.step)) == 12
+    spans = _spans(cfg)
+    # step 6 is a checkpoint-only boundary holding NaN state: skipped
+    skipped = [s for s in spans
+               if s["span"] == "checkpoint_save_skipped_nonfinite"]
+    assert [s["step"] for s in skipped] == [6]
+    # the log boundary at 8 detected it and rolled back to clean step 4
+    (rb,) = [s for s in spans if s["span"] == "nan_rollback"]
+    assert rb["from_step"] == 8 and rb["to_step"] == 4
+
+
+def test_corrupt_checkpoint_drill_restore_falls_back(tmp_path):
+    cfg = _drill_cfg(tmp_path, steps=8)
+    train(cfg)  # checkpoints at 4 and 8
+    assert resilience.corrupt_checkpoint(cfg.train.train_dir) == 8
+
+    cfg2 = _drill_cfg(tmp_path)  # steps=12: resume and finish
+    state = train(cfg2)
+    assert int(jax.device_get(state.step)) == 12
+    spans = _spans(cfg2)
+    failed = [s for s in spans if s["span"] == "checkpoint_restore_failed"]
+    assert [s["step"] for s in failed] == [8]
+    runs = [(s["start_step"], s["stop_step"]) for s in spans
+            if s["span"] == "run"]
+    assert runs == [(0, 8), (4, 12)]  # resumed from the previous step
+    assert latest_step_in(cfg.train.train_dir) == 12
+
+
+def test_emergency_save_on_inflight_crash(tmp_path, monkeypatch):
+    """Satellite: a crash mid-loop loses at most the current interval."""
+    from tpu_resnet.train import metrics_io
+
+    cfg = _drill_cfg(tmp_path)
+    cfg.train.checkpoint_every = 100  # only the emergency path can save
+    orig = metrics_io.MetricsWriter.write
+
+    def boom(self, step, m):
+        if step >= 6:
+            raise RuntimeError("disk full")
+        return orig(self, step, m)
+
+    monkeypatch.setattr(metrics_io.MetricsWriter, "write", boom)
+    with pytest.raises(RuntimeError, match="disk full"):
+        train(cfg)
+    # summary writes land at steps 4 and 8; the crash at 8 emergency-saved
+    saved = latest_step_in(cfg.train.train_dir)
+    assert saved == 8
+    assert any(s["span"] == "emergency_save" and s["step"] == 8
+               for s in _spans(cfg))
+
+
+def test_preempt_env_injection_and_stack_artifacts_clean(tmp_path,
+                                                        monkeypatch):
+    """The env-var injection channel (TPU_RESNET_FAULT_*) drives the same
+    drill as the config fields — the supervisor/chaos-schedule interface."""
+    monkeypatch.setenv("TPU_RESNET_FAULT_SIGTERM_STEP", "4")
+    cfg = _drill_cfg(tmp_path)
+    with pytest.raises(resilience.Preempted) as exc:
+        train(cfg)
+    assert exc.value.step == 4
+    assert latest_step_in(cfg.train.train_dir) == 4
+    # a clean preemption leaves no stall dumps behind
+    assert not glob.glob(os.path.join(cfg.train.train_dir,
+                                      "stall_stacks_*.txt"))
+
+
+def test_doctor_fault_drill_end_to_end():
+    """doctor --fault-drill: subprocess SIGTERM+resume via the real CLI —
+    also proves the preemption *exit code* contract that in-process drills
+    can't see."""
+    from tpu_resnet.tools import doctor
+
+    out = doctor._check_fault_drill(timeout=240)
+    assert out["ok"], out
+    assert out["preempt_rc"] == resilience.PREEMPT_EXIT_CODE
+    assert out["run_spans"] == [(0, 20), (20, 40)]

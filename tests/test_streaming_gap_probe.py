@@ -14,6 +14,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 import streaming_gap_probe  # noqa: E402
 
 
+@pytest.mark.slow  # 22s: three timed train-loop measurements of a bench
+# probe tool; the arg-validation sibling stays tier-1. Joined the slow
+# tier to keep the default tier inside the 870s verify budget (precedent:
+# the fused A/B smokes).
 def test_probe_tiny_config(tmp_path, monkeypatch):
     out = tmp_path / "gap.json"
     monkeypatch.setattr(sys, "argv", [

@@ -1,0 +1,112 @@
+#!/usr/bin/env python3
+"""Restart supervisor for preemptible training jobs.
+
+The trainer's graceful-shutdown path (tpu_resnet/resilience/shutdown.py)
+turns SIGTERM/SIGINT into: finish the chunk, save a final checkpoint,
+exit with a distinct code (default 42). This wrapper closes the loop — it
+reruns the command so the run resumes from that checkpoint, with two
+different policies by exit code:
+
+- **preempt code** (machine reclaimed, clean save on disk): restart after
+  a short fixed delay; these are expected and don't count against the
+  crash backoff.
+- **any other nonzero code** (real crash): restart with capped
+  exponential backoff (base · 2^crashes, up to --backoff-cap) so a
+  hard-broken job can't hot-loop the cluster; the crash streak resets on
+  any clean interval.
+- **0**: done, exit 0.
+
+Usage:
+
+    python tools/supervise.py [options] -- python -m tpu_resnet train \
+        --preset cifar10 train.train_dir=/data/run1
+
+Stdlib-only and jax-free: it must keep working on a host whose accelerator
+stack is the thing that is crashing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("tpu_resnet.supervise")
+
+# Keep in sync with tpu_resnet/resilience/shutdown.py PREEMPT_EXIT_CODE
+# (not imported: the supervisor must run without the package installed).
+DEFAULT_PREEMPT_CODE = 42
+
+
+def supervise(cmd, max_restarts: int = 100, preempt_code: int =
+              DEFAULT_PREEMPT_CODE, backoff_base: float = 1.0,
+              backoff_cap: float = 300.0, preempt_delay: float = 1.0,
+              run=None, sleep=time.sleep) -> int:
+    """Run ``cmd`` under the restart policy; returns the final exit code.
+    ``run``/``sleep`` are injectable for tests."""
+    if run is None:
+        run = lambda c: subprocess.call(c)  # noqa: E731
+    restarts = 0
+    crash_streak = 0
+    while True:
+        rc = run(cmd)
+        if rc == 0:
+            log.info("command exited 0 after %d restart(s)", restarts)
+            return 0
+        if restarts >= max_restarts:
+            log.error("giving up after %d restart(s); last exit code %d",
+                      restarts, rc)
+            return rc
+        restarts += 1
+        if rc == preempt_code:
+            crash_streak = 0
+            delay = preempt_delay
+            log.warning("preempted (exit %d) — resuming from the final "
+                        "checkpoint in %.1fs (restart %d/%d)", rc, delay,
+                        restarts, max_restarts)
+        else:
+            crash_streak += 1
+            delay = min(backoff_cap,
+                        backoff_base * (2 ** (crash_streak - 1)))
+            log.warning("crashed (exit %d) — restart %d/%d in %.1fs "
+                        "(crash streak %d)", rc, restarts, max_restarts,
+                        delay, crash_streak)
+        sleep(delay)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s",
+        datefmt="%H:%M:%S", stream=sys.stderr)
+    p = argparse.ArgumentParser(
+        description="restart wrapper: auto-resume on the trainer's "
+                    "preemption exit code, capped exponential backoff on "
+                    "crashes")
+    p.add_argument("--max-restarts", type=int, default=100)
+    p.add_argument("--preempt-code", type=int, default=DEFAULT_PREEMPT_CODE,
+                   help="exit code meaning 'preempted, resume me' "
+                        "(resilience.preempt_exit_code)")
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="first crash-restart delay, seconds")
+    p.add_argument("--backoff-cap", type=float, default=300.0,
+                   help="max crash-restart delay, seconds")
+    p.add_argument("--preempt-delay", type=float, default=1.0,
+                   help="fixed delay before resuming after a preemption")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="command to supervise (prefix with --)")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        p.error("no command given; usage: supervise.py [options] -- cmd ...")
+    return supervise(cmd, max_restarts=args.max_restarts,
+                     preempt_code=args.preempt_code,
+                     backoff_base=args.backoff_base,
+                     backoff_cap=args.backoff_cap,
+                     preempt_delay=args.preempt_delay)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -261,12 +261,54 @@ class TrainConfig:
 
 
 @dataclasses.dataclass
+class ResilienceConfig:
+    """Fault tolerance (tpu_resnet/resilience): recovery behavior and the
+    deterministic fault-injection drill knobs. Recovery is ON by default —
+    a preemptible-pod trainer that only recovers when asked recovers
+    never; injection is OFF by default and costs nothing when off."""
+
+    # SIGTERM/SIGINT → stop at the next chunk boundary, save a final
+    # checkpoint, exit with preempt_exit_code (tools/supervise.py resumes).
+    graceful_shutdown: bool = True
+    preempt_exit_code: int = 42  # resilience/shutdown.py PREEMPT_EXIT_CODE
+    # Non-finite loss at a log boundary (already host-synced there — zero
+    # extra device syncs): roll back to the last checkpoint, advance the
+    # data stream past the bad window, retry up to nan_max_retries times,
+    # then raise DivergenceError.
+    nan_guard: bool = True
+    nan_max_retries: int = 2
+    # No step progress for this many seconds → dump all-thread stacks to
+    # <train_dir>/stall_stacks_N.txt and flip /healthz unhealthy until
+    # progress resumes. 0 disables. Armed by the first completed dispatch,
+    # so a long first compile can never false-trigger it.
+    watchdog_stall_sec: float = 600.0
+    # On an in-flight training-loop exception, attempt one guarded
+    # ckpt.save(step, force=True) in the shutdown chain — a crash loses at
+    # most the current interval, not everything since checkpoint_every.
+    emergency_save: bool = True
+    # Eval sidecar: retries (with exponential backoff) for a restore of a
+    # just-committing checkpoint before the step is skipped-and-logged.
+    eval_restore_retries: int = 3
+    eval_restore_backoff_sec: float = 0.5
+    # ---- fault injection (resilience/faultinject.py; drills only) ----
+    # All off by default; TPU_RESNET_FAULT_{NAN_STEP,STALL_STEP,STALL_SEC,
+    # SIGTERM_STEP,CORRUPT_CKPT} env vars override these fields.
+    inject_nan_at_step: int = -1
+    inject_stall_at_step: int = -1
+    inject_stall_seconds: float = 0.0
+    inject_sigterm_at_step: int = -1
+    inject_corrupt_ckpt: bool = False
+
+
+@dataclasses.dataclass
 class RunConfig:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig)
 
     # ---------------------------------------------------------- serialization
     def to_dict(self) -> dict:

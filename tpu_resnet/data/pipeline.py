@@ -29,6 +29,13 @@ import numpy as np
 
 Batch = Tuple[np.ndarray, np.ndarray]
 
+# BackgroundIterator liveness knobs (module-level so tests can tighten
+# them): how long the consumer's get() waits between producer-liveness
+# checks, and how long an erroring producer tries the ordered put before
+# freeing a slot (drain-then-put).
+GET_POLL_SEC = 1.0
+ERROR_PUT_TIMEOUT_SEC = 2.0
+
 
 class ShardedBatcher:
     """Infinite shuffled batches over a per-process shard of an in-memory
@@ -98,44 +105,92 @@ class BackgroundIterator:
     of the reference's QueueRunner prefetching (cifar_input.py:99-100), one
     thread being enough since augmentation moved on-device."""
 
-    def __init__(self, it: Iterator, capacity: int = 4):
+    def __init__(self, it: Iterator, capacity: int = 4,
+                 external_stop: Optional[threading.Event] = None):
+        """``external_stop``: an event whose set() ends iteration at the
+        consumer within ~GET_POLL_SEC even while the producer is stalled —
+        the hook that lets a graceful preemption stop (tpu_resnet/
+        resilience) unblock a loop stuck in next() on a dead data source
+        and still save its final checkpoint inside the grace window."""
         self._q: queue.Queue = queue.Queue(maxsize=capacity)
         self._it = it
         self._stop = threading.Event()
+        self._external_stop = external_stop
         self._thread = threading.Thread(target=self._fill, daemon=True)
         self._thread.start()
 
     def _fill(self):
         try:
             for item in self._it:
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(item, timeout=0.2)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
+                if not self._put(item):
                     return
         except Exception as e:  # surface loader errors to the consumer
-            self._q.put(e)
-        self._q.put(StopIteration)
+            # Error path must never deadlock against a full queue (the old
+            # unconditional put(e) could block forever against a consumer
+            # that stopped draining). Preserve ordering when there is
+            # room; if the queue stays full, drop the buffered batches —
+            # the error is terminal anyway — and enqueue the exception
+            # into the freed slot.
+            try:
+                self._q.put(e, timeout=ERROR_PUT_TIMEOUT_SEC)
+            except queue.Full:
+                self._drain()
+                try:
+                    self._q.put_nowait(e)
+                except queue.Full:  # pragma: no cover - sole producer
+                    pass
+            return  # no StopIteration after an error: the consumer raises
+        self._put(StopIteration)
+
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False when close() was requested."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _drain(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
 
     def close(self):
         """Release the producer thread and its buffered items (for
         consumers that stop early, e.g. benchmark warm-ups)."""
         self._stop.set()
-        while not self._q.empty():
-            try:
-                self._q.get_nowait()
-            except queue.Empty:
-                break
+        self._drain()
         self._thread.join(timeout=5)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self._q.get()
+        # Bounded-timeout get with a producer-liveness check: a producer
+        # thread that dies without enqueueing its exception (killed
+        # interpreter-side, raised something Exception doesn't catch) must
+        # surface as an error here, not block the training loop forever.
+        while True:
+            try:
+                item = self._q.get(timeout=GET_POLL_SEC)
+                break
+            except queue.Empty:
+                if (self._external_stop is not None
+                        and self._external_stop.is_set()):
+                    raise StopIteration  # preemption: stop waiting for data
+                if self._thread.is_alive():
+                    continue  # slow source, live producer: keep waiting
+                try:  # producer exited; take anything it managed to leave
+                    item = self._q.get_nowait()
+                    break
+                except queue.Empty:
+                    raise RuntimeError(
+                        "BackgroundIterator producer thread died without "
+                        "yielding a result or an error") from None
         if item is StopIteration:
             raise StopIteration
         if isinstance(item, Exception):

@@ -110,6 +110,30 @@ def _template_state(cfg: RunConfig, model, mesh):
     return jax.device_put(state, parallel.replicated(mesh))
 
 
+def _restore_with_retry(ckpt, template, step: int, retries: int = 3,
+                        backoff_sec: float = 0.5, sleep=time.sleep):
+    """Restore ``step`` with bounded exponential-backoff retries.
+
+    The trainer's saves are async: the evaluator's poll can see a step
+    whose directory is still mid-commit, and a single transient restore
+    failure used to kill the whole sidecar loop. Returns the state, or
+    None after ``retries`` failures (the caller skips-and-logs the step
+    instead of crashing — the next checkpoint will be evaluated fine)."""
+    for attempt in range(max(1, retries)):
+        try:
+            return ckpt.restore(template, step=step)
+        except Exception as e:  # noqa: BLE001 - any restore failure
+            wait = backoff_sec * (2 ** attempt)
+            log.warning("restore of checkpoint step %d failed "
+                        "(attempt %d/%d, %s: %s)%s", step, attempt + 1,
+                        max(1, retries), type(e).__name__, e,
+                        f"; retrying in {wait:.1f}s"
+                        if attempt + 1 < max(1, retries) else "")
+            if attempt + 1 < max(1, retries):
+                sleep(wait)
+    return None
+
+
 def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
     """Continuous (or once) evaluation; returns last precision.
 
@@ -159,7 +183,23 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
                     break
                 continue
             if step != last_seen:
-                state = ckpt.restore(template, step=step)
+                state = _restore_with_retry(
+                    ckpt, template, step,
+                    retries=cfg.resilience.eval_restore_retries,
+                    backoff_sec=cfg.resilience.eval_restore_backoff_sec)
+                if state is None:
+                    # Skip-and-log, never crash the sidecar: mark the step
+                    # seen so the poll doesn't spin on it; the next
+                    # committed checkpoint evaluates normally.
+                    log.error("skipping eval of checkpoint step %d — "
+                              "restore failed repeatedly", step)
+                    spans.event("eval_restore_failed", step=step)
+                    last_seen = step
+                    if cfg.train.eval_once:
+                        break
+                    if not _wait():
+                        break
+                    continue
                 t0 = time.perf_counter()
                 with spans.span("eval_pass", step=step) as span_attrs:
                     precision, loss, count = run_eval_pass(cfg, state, mesh,

@@ -88,6 +88,11 @@ def main(argv=None):
                                 "server answers /metrics + /healthz")
             p.add_argument("--probe-timeout", type=int, default=60)
             p.add_argument("--mesh-devices", type=int, default=8)
+            p.add_argument("--fault-drill", action="store_true",
+                           help="run a live SIGTERM+resume drill against "
+                                "a temp train_dir (~30s tiny CPU run): "
+                                "preemption exit code, final checkpoint, "
+                                "exact-step resume")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -102,7 +107,8 @@ def main(argv=None):
         summary = run_doctor(dataset=args.dataset, data_dir=args.data_dir,
                              train_dir=args.train_dir,
                              probe_timeout=args.probe_timeout,
-                             mesh_devices=args.mesh_devices)
+                             mesh_devices=args.mesh_devices,
+                             fault_drill=args.fault_drill)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config
@@ -110,16 +116,31 @@ def main(argv=None):
 
     if args.command == "train":
         from tpu_resnet import parallel
+        from tpu_resnet.resilience import Preempted
         from tpu_resnet.train import train
         parallel.initialize()
-        train(cfg)
+        try:
+            train(cfg)
+        except Preempted as e:
+            # Distinct exit code: a supervisor (tools/supervise.py, or any
+            # restart policy) resumes on this code instead of backing off
+            # as for a crash. The final checkpoint is already on disk.
+            logging.getLogger("tpu_resnet").warning(
+                "%s — exiting %d", e, cfg.resilience.preempt_exit_code)
+            return cfg.resilience.preempt_exit_code
         return 0
 
     if args.command == "train_and_eval":
         from tpu_resnet import parallel
         from tpu_resnet.evaluation import train_and_eval
+        from tpu_resnet.resilience import Preempted
         parallel.initialize()
-        train_and_eval(cfg)
+        try:
+            train_and_eval(cfg)
+        except Preempted as e:
+            logging.getLogger("tpu_resnet").warning(
+                "%s — exiting %d", e, cfg.resilience.preempt_exit_code)
+            return cfg.resilience.preempt_exit_code
         return 0
 
     if args.command == "eval":

@@ -53,6 +53,11 @@ CORE_GAUGES = (
     ("data_wait_frac", "Fraction of interval wall time blocked on input"),
     ("compile_seconds", "First-dispatch wall time (trace+compile+run)"),
     ("checkpoint_lag_steps", "Steps since the last checkpoint save"),
+    # Fault counters (tpu_resnet/resilience) — pre-declared so a scrape on
+    # a healthy run reports explicit zeros, not absent series.
+    ("fault_nan_rollbacks", "NaN/divergence rollbacks performed"),
+    ("fault_watchdog_stalls", "Hang-watchdog stall detections"),
+    ("fault_preemptions", "Graceful preemption stops (SIGTERM/SIGINT)"),
 )
 
 
@@ -71,6 +76,7 @@ class TelemetryRegistry:
         self._help: Dict[str, str] = {}
         self._hb_wall: Optional[float] = None
         self._hb_step: Optional[int] = None
+        self._unhealthy_reason: Optional[str] = None
         self._started = time.time()
         for name, help_text in CORE_GAUGES:
             self.set(name, 0.0, help=help_text)
@@ -103,17 +109,32 @@ class TelemetryRegistry:
                 else self._started
         return max(0.0, time.time() - base)
 
+    def mark_unhealthy(self, reason: str) -> None:
+        """Force /healthz to 503 with an explicit reason — used by the
+        hang watchdog, whose stall deadline is typically much tighter than
+        the heartbeat-staleness threshold."""
+        with self._lock:
+            self._unhealthy_reason = str(reason)
+
+    def clear_unhealthy(self) -> None:
+        with self._lock:
+            self._unhealthy_reason = None
+
     def health(self) -> dict:
         age = self.heartbeat_age()
         with self._lock:
             step = self._hb_step
-        return {
-            "ok": age < self.stale_after_sec,
+            reason = self._unhealthy_reason
+        out = {
+            "ok": age < self.stale_after_sec and reason is None,
             "step": step,
             "heartbeat_age_sec": round(age, 3),
             "stale_after_sec": self.stale_after_sec,
             "time": time.time(),
         }
+        if reason is not None:
+            out["unhealthy_reason"] = reason
+        return out
 
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4."""

@@ -1,0 +1,62 @@
+"""Fault tolerance — the recovery paths the reference never had.
+
+The reference's only recovery story is MonitoredTrainingSession's implicit
+resume-from-latest-checkpoint (reference resnet_imagenet_train.py:267-270);
+preemption, divergence, data stalls and corrupt checkpoints all turn into
+raw stack traces or silent hangs. On preemptible TPU pods those are the
+*dominant* failure modes (arXiv:1909.09756 runs MLPerf on pods where any
+host can vanish mid-step; arXiv:1605.08695 §4.3 names the checkpoint-
+restore contract as the system's core fault-tolerance mechanism). This
+package makes each one a handled path:
+
+``shutdown``     ShutdownCoordinator — SIGTERM/SIGINT request a stop at the
+                 next chunk boundary; the loop saves a final checkpoint,
+                 closes telemetry, and ``train()`` raises ``Preempted`` so
+                 the CLI can exit with a distinct code
+                 (``PREEMPT_EXIT_CODE``) that a supervisor
+                 (tools/supervise.py) auto-resumes on.
+``sentinel``     NaNSentinel — loss finiteness checked at the existing log
+                 boundaries (already host-synced there: zero extra device
+                 syncs); on trigger the loop rolls back to the last
+                 checkpoint, advances the data stream past the bad window,
+                 and retries a bounded number of times before raising
+                 ``DivergenceError``.
+``watchdog``     HangWatchdog — a daemon thread that dumps all-thread
+                 stacks and flips ``/healthz`` unhealthy when step progress
+                 stalls past a configurable deadline, and clears the flag
+                 when progress resumes.
+``faultinject``  FaultPlan/FaultInjector — deterministic, config/env-driven
+                 fault injection (NaN batch at step N, data stall of S
+                 seconds, SIGTERM at step N, checkpoint corruption), off by
+                 default, used by the drill tests and ``doctor
+                 --fault-drill`` to prove every recovery path end-to-end.
+
+Checkpoint-level fallback (restore falls back through ``all_steps()`` to
+the newest restorable checkpoint) lives in ``train/checkpoint.py``; the
+input-pipeline liveness fixes live in ``data/pipeline.py``.
+"""
+
+from tpu_resnet.resilience.faultinject import (
+    FaultInjector,
+    FaultPlan,
+    corrupt_checkpoint,
+)
+from tpu_resnet.resilience.sentinel import DivergenceError, NaNSentinel
+from tpu_resnet.resilience.shutdown import (
+    PREEMPT_EXIT_CODE,
+    Preempted,
+    ShutdownCoordinator,
+)
+from tpu_resnet.resilience.watchdog import HangWatchdog
+
+__all__ = [
+    "PREEMPT_EXIT_CODE",
+    "DivergenceError",
+    "FaultInjector",
+    "FaultPlan",
+    "HangWatchdog",
+    "NaNSentinel",
+    "Preempted",
+    "ShutdownCoordinator",
+    "corrupt_checkpoint",
+]

@@ -1,0 +1,160 @@
+"""Deterministic fault injection — the drill harness.
+
+A recovery path that has never fired is a recovery path that does not
+work. The injector plants exactly one fault of each requested kind at a
+deterministic step, so the drill tests (tests/test_resilience_drills.py)
+and ``doctor --fault-drill`` can prove every path end-to-end: NaN batch →
+sentinel rollback; data stall → watchdog fires and the stream recovers;
+SIGTERM → graceful save + distinct exit code + resume; corrupt checkpoint
+→ restore fallback.
+
+Everything is **off by default**: an empty plan wraps nothing and costs
+nothing. Sources, in precedence order:
+
+1. ``TPU_RESNET_FAULT_*`` environment variables (drills driven from
+   outside the config system, e.g. a supervisor chaos schedule);
+2. the ``resilience.inject_*`` config fields.
+
+Each fault is one-shot *per injector object* — the injector outlives a
+sentinel rollback's iterator rebuild, so a recovered run does not re-hit
+the same fault it just survived.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+
+import numpy as np
+
+log = logging.getLogger("tpu_resnet")
+
+ENV_PREFIX = "TPU_RESNET_FAULT_"
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    nan_at_step: int = -1        # poison the batch consumed at this step
+    stall_at_step: int = -1      # producer sleeps before this step's batch
+    stall_seconds: float = 0.0
+    sigterm_at_step: int = -1    # SIGTERM to self at this chunk boundary
+    corrupt_ckpt_at_start: bool = False  # corrupt newest ckpt before restore
+
+    @property
+    def active(self) -> bool:
+        return (self.nan_at_step >= 0 or self.sigterm_at_step >= 0
+                or (self.stall_at_step >= 0 and self.stall_seconds > 0)
+                or self.corrupt_ckpt_at_start)
+
+    @classmethod
+    def from_config(cls, resilience_cfg, env=None) -> "FaultPlan":
+        """Config fields overridden by ``TPU_RESNET_FAULT_*`` env vars:
+        NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT."""
+        env = os.environ if env is None else env
+        r = resilience_cfg
+
+        def pick(env_key, cfg_val, cast):
+            raw = env.get(ENV_PREFIX + env_key)
+            return cast(raw) if raw not in (None, "") else cfg_val
+
+        return cls(
+            nan_at_step=pick("NAN_STEP", r.inject_nan_at_step, int),
+            stall_at_step=pick("STALL_STEP", r.inject_stall_at_step, int),
+            stall_seconds=pick("STALL_SEC", r.inject_stall_seconds, float),
+            sigterm_at_step=pick("SIGTERM_STEP", r.inject_sigterm_at_step,
+                                 int),
+            corrupt_ckpt_at_start=pick(
+                "CORRUPT_CKPT", r.inject_corrupt_ckpt,
+                lambda v: v.lower() in ("1", "true", "yes")),
+        )
+
+
+class FaultInjector:
+    """Applies a :class:`FaultPlan`, once per fault, at exact steps."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._nan_fired = False
+        self._stall_fired = False
+        self._sigterm_fired = False
+        self._corrupt_fired = False
+        if plan.active:
+            log.warning("FAULT INJECTION ACTIVE: %s", plan)
+
+    @property
+    def wraps_data(self) -> bool:
+        return self.plan.nan_at_step >= 0 or (
+            self.plan.stall_at_step >= 0 and self.plan.stall_seconds > 0)
+
+    def wrap_host_batches(self, it, start_step: int = 0):
+        """Wrap a host batch iterator; batch ``i`` of the wrapped stream is
+        the one consumed at global step ``start_step + i``. Returns ``it``
+        untouched when no data fault is planned (the default): zero
+        overhead, identical stream object."""
+        if not self.wraps_data:
+            return it
+
+        def wrapped():
+            for i, (images, labels) in enumerate(it):
+                step = start_step + i
+                if (self.plan.stall_at_step == step
+                        and not self._stall_fired):
+                    self._stall_fired = True
+                    log.warning("injecting %.1fs data stall before the "
+                                "step-%d batch", self.plan.stall_seconds,
+                                step)
+                    time.sleep(self.plan.stall_seconds)
+                if self.plan.nan_at_step == step and not self._nan_fired:
+                    self._nan_fired = True
+                    log.warning("injecting NaN batch at step %d", step)
+                    images = np.full_like(np.asarray(images, np.float32),
+                                          np.nan)
+                yield images, labels
+
+        return wrapped()
+
+    def maybe_sigterm(self, step: int) -> None:
+        """SIGTERM this process at the first chunk boundary >= the planned
+        step (the loop calls this where a real preemption would land)."""
+        if (self.plan.sigterm_at_step >= 0 and not self._sigterm_fired
+                and step >= self.plan.sigterm_at_step):
+            self._sigterm_fired = True
+            import signal
+
+            log.warning("injecting SIGTERM at step %d", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_corrupt_checkpoint(self, train_dir: str) -> None:
+        """Corrupt the newest checkpoint before the startup restore (the
+        drill for the restore-fallback path)."""
+        if self.plan.corrupt_ckpt_at_start and not self._corrupt_fired:
+            self._corrupt_fired = True
+            step = corrupt_checkpoint(train_dir)
+            log.warning("injected corruption into checkpoint step %s under "
+                        "%s", step, train_dir)
+
+
+def corrupt_checkpoint(directory: str, step=None):
+    """Overwrite every regular file of one checkpoint step with garbage
+    (default: the newest step). Returns the corrupted step, or None when
+    the directory holds no step-numbered checkpoints. Used by the drills;
+    the restore fallback must then skip this step."""
+    directory = os.path.abspath(directory)
+    steps = sorted(int(name) for name in os.listdir(directory)
+                   if name.isdigit()) if os.path.isdir(directory) else []
+    if not steps:
+        return None
+    step = max(steps) if step is None else int(step)
+    step_dir = os.path.join(directory, str(step))
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                size = max(os.path.getsize(path), 16)
+                with open(path, "wb") as f:
+                    f.write(b"\xde\xad\xbe\xef" * ((size + 3) // 4))
+            except OSError:
+                pass
+    return step

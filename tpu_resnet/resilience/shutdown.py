@@ -1,0 +1,121 @@
+"""Preemption-graceful shutdown.
+
+Preemptible TPU VMs get SIGTERM with a short grace window before the
+machine disappears; the reference trainer dies mid-step and loses
+everything since the last 1000-step checkpoint. The coordinator converts
+the signal into a *request*: the training loop finishes the in-flight
+fused chunk, saves a final checkpoint, runs its normal closer chain, and
+``train()`` raises :class:`Preempted` — which the CLI maps to
+``PREEMPT_EXIT_CODE`` so a supervisor (tools/supervise.py, or any restart
+policy keyed on exit codes) can distinguish "machine reclaimed, resume me"
+from a real crash.
+
+A second signal while the first is still being honored escalates: the
+original handlers are restored and ``KeyboardInterrupt`` is raised, so an
+operator hammering Ctrl-C is never trapped behind a slow final save.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("tpu_resnet")
+
+# Distinct from every shell/Python convention in use: 0 ok, 1 crash,
+# 2 usage, 124 timeout(1), 126/127 spawn, 128+N killed-by-signal.
+PREEMPT_EXIT_CODE = 42
+
+
+class Preempted(Exception):
+    """Raised by ``train()`` after a graceful preemption stop: the final
+    checkpoint is on disk and telemetry is closed. Carries the stop step
+    and the final state so in-process callers (tests, notebooks) can
+    inspect them; the CLI maps it to ``PREEMPT_EXIT_CODE``."""
+
+    def __init__(self, step: int, state=None, signum: Optional[int] = None):
+        self.step = int(step)
+        self.state = state
+        self.signum = signum
+        name = signal.Signals(signum).name if signum is not None else "?"
+        super().__init__(
+            f"training preempted by {name} at step {step}; final "
+            f"checkpoint saved — restart to resume")
+
+
+class ShutdownCoordinator:
+    """Installable SIGTERM/SIGINT → stop-request flag.
+
+    ``install()`` is a no-op off the main thread (CPython only delivers
+    signals there, and ``signal.signal`` raises elsewhere) and when
+    ``enabled=False`` — ``requested`` then just stays False and the
+    process keeps its default signal behavior."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.signum: Optional[int] = None
+        self.requested_at: Optional[float] = None
+        self._event = threading.Event()
+        self._previous = {}
+
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def event(self) -> threading.Event:
+        """The stop-request event, for consumers that block outside the
+        loop (e.g. the input pipeline's consumer-side get)."""
+        return self._event
+
+    def request_stop(self, signum: Optional[int] = None) -> None:
+        """Programmatic stop request (what the signal handler calls)."""
+        if self.signum is None:
+            self.signum = signum
+            self.requested_at = time.time()
+        self._event.set()
+
+    def _handle(self, signum, frame) -> None:
+        if self._event.is_set():
+            # Second signal: the operator wants OUT, not a slow final
+            # save. Put the default handlers back and raise.
+            self.uninstall()
+            raise KeyboardInterrupt(
+                f"second {signal.Signals(signum).name} during graceful "
+                f"shutdown — aborting immediately")
+        log.warning("received %s: finishing the current chunk, saving a "
+                    "final checkpoint, then exiting with code %d "
+                    "(send again to abort immediately)",
+                    signal.Signals(signum).name, PREEMPT_EXIT_CODE)
+        self.request_stop(signum)
+
+    def install(self) -> "ShutdownCoordinator":
+        if not self.enabled or self._previous:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for sig in self.SIGNALS:
+            try:
+                self._previous[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError):  # exotic embedding; stay inert
+                self._previous.pop(sig, None)
+        return self
+
+    def uninstall(self) -> None:
+        prev, self._previous = self._previous, {}
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+    def __enter__(self) -> "ShutdownCoordinator":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

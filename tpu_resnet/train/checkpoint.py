@@ -49,23 +49,95 @@ class CheckpointManager:
                                step=int(step), **{"async": True})
         return saved
 
-    def restore(self, state_template, step: Optional[int] = None):
-        """Restore into the structure/shardings of ``state_template``."""
+    def restore(self, state_template, step: Optional[int] = None,
+                fallback: Optional[bool] = None,
+                discard_failed: bool = False):
+        """Restore into the structure/shardings of ``state_template``.
+
+        ``fallback`` (default: on exactly when ``step`` is None) is the
+        corrupt-checkpoint recovery path: if the newest checkpoint fails to
+        restore — torn write from a preempted host, bad storage — fall back
+        through ``all_steps()`` to the newest *restorable* one (we keep
+        ``keep``, default 5) instead of raising. An explicitly requested
+        step (evaluator, export) fails loudly by default: silently serving
+        an older step than asked for would corrupt eval curves.
+
+        ``discard_failed`` additionally deletes/quarantines the steps that
+        failed to restore once a fallback succeeds. Only the *trainer's*
+        resume path sets it (the process that owns the directory and will
+        re-reach those step numbers, colliding on save): a read-only
+        consumer (export, a notebook) must never destroy a checkpoint that
+        merely failed transiently for *it*."""
+        import logging
         import time
 
+        if fallback is None:
+            fallback = step is None
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        candidates = [step]
+        if fallback:
+            candidates += sorted((s for s in self.all_steps() if s < step),
+                                 reverse=True)
         abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
                                           state_template)
-        t0 = time.time()
-        restored = self._mgr.restore(step,
-                                     args=ocp.args.StandardRestore(abstract))
-        if self._spans is not None:
-            self._spans.record("checkpoint_restore", t0, time.time(),
-                               step=int(step))
-        return restored
+        log = logging.getLogger("tpu_resnet")
+        last_err = None
+        failed = []
+        for i, cand in enumerate(candidates):
+            t0 = time.time()
+            try:
+                restored = self._mgr.restore(
+                    cand, args=ocp.args.StandardRestore(abstract))
+            except Exception as e:  # noqa: BLE001 - any restore failure
+                last_err = e
+                failed.append(cand)
+                log.warning("checkpoint step %d failed to restore (%s: %s)%s",
+                            cand, type(e).__name__, e,
+                            " — falling back to the previous step"
+                            if i + 1 < len(candidates) else "")
+                if self._spans is not None:
+                    self._spans.record(
+                        "checkpoint_restore_failed", t0, time.time(),
+                        step=int(cand),
+                        error=f"{type(e).__name__}: {e}"[:200])
+                continue
+            attrs = {"step": int(cand)}
+            if cand != candidates[0]:
+                attrs["fallback_from_step"] = int(candidates[0])
+            if self._spans is not None:
+                self._spans.record("checkpoint_restore", t0, time.time(),
+                                   **attrs)
+            if discard_failed:
+                # Trainer resume: the unrestorable newer steps must go —
+                # latest_step()/pollers would keep finding them, and the
+                # resumed run will re-reach those step numbers and collide
+                # with the corrupt directories on save.
+                self._discard(failed, log)
+            return restored
+        raise RuntimeError(
+            f"no restorable checkpoint in {self.directory}: all of "
+            f"{candidates} failed; newest error: "
+            f"{type(last_err).__name__}: {last_err}") from last_err
+
+    def _discard(self, steps, log) -> None:
+        """Remove checkpoints that failed to restore (delete via orbax so
+        its step cache stays coherent; quarantine-rename as a fallback)."""
+        for bad in steps:
+            try:
+                self._mgr.delete(bad)
+                log.warning("removed unrestorable checkpoint step %d", bad)
+            except Exception:  # noqa: BLE001 - best-effort quarantine
+                src = os.path.join(self.directory, str(bad))
+                try:
+                    os.rename(src, src + ".corrupt")
+                    log.warning("quarantined unrestorable checkpoint step "
+                                "%d as %s.corrupt", bad, src)
+                except OSError as e:
+                    log.warning("could not remove corrupt checkpoint step "
+                                "%d: %s", bad, e)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -17,6 +17,7 @@ async-PS, Horovod — SURVEY.md §2.3): the mesh decides the distribution.
 from __future__ import annotations
 
 import logging
+import math
 import time
 from typing import Optional
 
@@ -24,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_resnet import obs, parallel
+from tpu_resnet import obs, parallel, resilience
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.data import device_data
@@ -40,25 +41,36 @@ from tpu_resnet.train.step import make_train_step, shard_step
 log = logging.getLogger("tpu_resnet")
 
 
-def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0):
+def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0,
+                         injector=None, stop_event=None):
     """Host pipeline: per-process shard → background batcher → device
     prefetch queue. With ``transfer_stage`` > 1 the iterator yields whole
     ``(stage, B, ...)`` superbatches (one transfer each) plus their length;
-    the loop fuses those steps into single dispatches."""
+    the loop fuses those steps into single dispatches.
+
+    Returns ``(device_iter, stage, host_iter)``; the ``host_iter``
+    (BackgroundIterator) handle lets the NaN-rollback path release the
+    producer thread before rebuilding the stream past the bad window.
+    ``injector`` (resilience.FaultInjector) wraps the host batch stream
+    with its planned data faults; a default (inactive) plan returns the
+    stream object untouched."""
     import tpu_resnet.data as data_lib
 
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
     stage = max(1, cfg.data.transfer_stage)
+    batches = data_lib.train_batches(cfg.data, local_bs, seed=cfg.train.seed,
+                                     start_step=start_step)
+    if injector is not None:
+        batches = injector.wrap_host_batches(batches, start_step=start_step)
     host_iter = pipeline.BackgroundIterator(
-        data_lib.train_batches(cfg.data, local_bs, seed=cfg.train.seed,
-                               start_step=start_step),
-        capacity=stage * cfg.data.prefetch + 2)
+        batches, capacity=stage * cfg.data.prefetch + 2,
+        external_stop=stop_event)
     if stage > 1:
         return pipeline.staged_superbatch_prefetch(
             host_iter, parallel.staged_batch_sharding(mesh),
-            stage=stage, depth=cfg.data.prefetch), stage
+            stage=stage, depth=cfg.data.prefetch), stage, host_iter
     return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
-                                    depth=cfg.data.prefetch), 1
+                                    depth=cfg.data.prefetch), 1, host_iter
 
 
 def _chunk_len(step: int, total: int, train_cfg, steps_per_epoch: int,
@@ -134,103 +146,139 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     server = obs.TelemetryServer.maybe_start(  # step once state is known
         cfg.train.telemetry_port, telemetry, train_dir=cfg.train.train_dir)
 
-    ckpt = CheckpointManager(cfg.train.train_dir,
-                             keep=cfg.train.keep_checkpoints, spans=spans)
-    latest = ckpt.latest_step()
-    if latest is not None:
-        state = ckpt.restore(state)
-        log.info("resumed from step %d in %s", latest, cfg.train.train_dir)
-
-    if metrics is None:
-        metrics = MetricsWriter(cfg.train.train_dir,
-                                enabled=parallel.is_primary())
-
-    # Per-replica BN (reference semantics, model.sync_bn=False) runs the
-    # step inside shard_map with explicit pmeans; the default is global-
-    # batch BN under auto-sharded jit.
-    per_replica_bn = (not cfg.model.sync_bn) and mesh.shape["data"] > 1
-    if cfg.model.fused_blocks and mesh.shape["data"] > 1 \
-            and not per_replica_bn:
-        # The fused kernels take batch moments over the batch the kernel
-        # sees; their supported multi-chip dispatch is shard_map-explicit
-        # (each replica's Pallas call gets its concrete local shard —
-        # per-replica BN, the reference's semantics, resnet_model.py:
-        # 120-122). Global-batch sync-BN under auto-sharded jit is not
-        # implemented for the Pallas custom call: fail loudly rather than
-        # ship unclear moment semantics (VERDICT r4 item 5).
-        raise ValueError(
-            "model.fused_blocks on a multi-chip data axis requires "
-            "model.sync_bn=false (per-replica BN via shard_map — the "
-            "reference's BN semantics); global-batch sync-BN is not "
-            "implemented for the fused kernels")
-    base_step = make_train_step(model, cfg.optim, schedule,
-                                cfg.data.num_classes, augment_fn,
-                                base_rng=step_rng, mesh=mesh,
-                                grad_axis="data" if per_replica_bn else None)
-
-    step = int(jax.device_get(state.step))
-    total = max_steps if max_steps is not None else cfg.train.train_steps
-
-    # Input edge: device-resident (whole split in HBM, batches cut
-    # on-device, multi-step dispatch) when it applies, else the streaming
-    # host pipeline.
-    resident = device_data.should_use(cfg.data)
-    if resident:
-        import tpu_resnet.data as data_lib
-
-        images_np, labels_np = data_lib.load_split(cfg.data, train=True)
-        ds = device_data.DeviceDataset(mesh, images_np, labels_np,
-                                       cfg.train.global_batch_size,
-                                       seed=cfg.train.seed)
-        run_chunk = device_data.compile_resident_steps(
-            base_step, ds, mesh, max(1, cfg.train.steps_per_call),
-            per_replica_bn=per_replica_bn)
-        data_iter = None
-    else:
-        data_iter, stage = build_train_iterator(cfg, mesh, start_step=step)
-        if stage > 1:
-            run_staged = device_data.compile_staged_stream_steps(
-                base_step, mesh, per_replica_bn=per_replica_bn)
-        else:
-            train_step = shard_step(base_step, mesh,
-                                    per_replica_bn=per_replica_bn)
-
-    meter = ThroughputMeter(cfg.train.global_batch_size,
-                            num_chips=mesh.size)
-    log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
-             "global batch %d | input %s", cfg.model.name, cfg.data.dataset,
-             total, n_params / 1e6, dict(mesh.shape),
-             cfg.train.global_batch_size,
-             "device-resident" if resident else "streaming")
-
-    profiling.maybe_start_server(cfg.train.profiler_port)
-    tracer = profiling.StepTracer(cfg.train.train_dir,
-                                  cfg.train.profile_steps, spans=spans)
-
-    # Step-time breakdown (tpu_resnet/obs/breakdown.py): data_wait /
-    # dispatch / sampled device backlog per log interval, compile time of
-    # the first dispatch reported separately. Sampling reuses the existing
-    # log boundaries (chunks already end exactly there), so it never
-    # changes fusion behavior.
-    breakdown = obs.StepBreakdown()
-    telemetry.heartbeat(step)
-    run_wall0 = time.time()
-    start_step = step
-    last_ckpt_step = step  # resumed or fresh: the last synced point
-    first_dispatch = True
-
-    meter.rate(step)
-    last_summary = step
-    last_sync = step  # last step the host fully drained the device at
-    m = None  # metrics of the newest dispatched chunk
-    stage_buf = None  # current streaming superbatch: (gi, gl, k, offset)
-    # Raw input images for the image-summary channel (reference
-    # cifar_input.py:118): the resident split's head, or the newest
-    # streamed batch; augmented at write time so the summary shows what
-    # the model actually saw.
-    last_inputs = images_np[:4] if resident else None
+    # Everything from here (resilience install, restore, step compile,
+    # iterator construction) runs INSIDE the try: a setup failure — a
+    # bad restore, a config ValueError, an iterator error — must still
+    # run the closer chain, or the process-global signal handlers, the
+    # watchdog thread, the telemetry server and the spans file leak
+    # into the (in-process) caller.
+    rcfg = cfg.resilience
+    shutdown = watchdog = ckpt = tracer = host_iter = None
+    m = None
+    run_wall0 = None
+    step = last_ckpt_step = 0
+    total = None
     try:
+        # Fault-tolerance layer (tpu_resnet/resilience): preemption-graceful
+        # shutdown, NaN rollback, hang watchdog — and, drills only, the
+        # deterministic fault injector (inactive plan = zero overhead).
+        injector = resilience.FaultInjector(resilience.FaultPlan.from_config(rcfg))
+        shutdown = resilience.ShutdownCoordinator(
+            enabled=rcfg.graceful_shutdown).install()
+        sentinel = resilience.NaNSentinel(rcfg.nan_max_retries,
+                                          enabled=rcfg.nan_guard)
+        watchdog = resilience.HangWatchdog.maybe_start(
+            rcfg.watchdog_stall_sec, cfg.train.train_dir,
+            telemetry=telemetry, spans=spans)
+
+        injector.maybe_corrupt_checkpoint(cfg.train.train_dir)
+        ckpt = CheckpointManager(cfg.train.train_dir,
+                                 keep=cfg.train.keep_checkpoints, spans=spans)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            # restore() falls back through all_steps() past corrupt/torn
+            # checkpoints to the newest restorable one; as the directory's
+            # owner, the trainer also discards the steps that failed (the run
+            # will re-reach those step numbers and must be able to save them).
+            state = ckpt.restore(state, discard_failed=True)
+            log.info("resumed from step %d in %s",
+                     int(jax.device_get(state.step)), cfg.train.train_dir)
+
+        if metrics is None:
+            metrics = MetricsWriter(cfg.train.train_dir,
+                                    enabled=parallel.is_primary())
+
+        # Per-replica BN (reference semantics, model.sync_bn=False) runs the
+        # step inside shard_map with explicit pmeans; the default is global-
+        # batch BN under auto-sharded jit.
+        per_replica_bn = (not cfg.model.sync_bn) and mesh.shape["data"] > 1
+        if cfg.model.fused_blocks and mesh.shape["data"] > 1 \
+                and not per_replica_bn:
+            # The fused kernels take batch moments over the batch the kernel
+            # sees; their supported multi-chip dispatch is shard_map-explicit
+            # (each replica's Pallas call gets its concrete local shard —
+            # per-replica BN, the reference's semantics, resnet_model.py:
+            # 120-122). Global-batch sync-BN under auto-sharded jit is not
+            # implemented for the Pallas custom call: fail loudly rather than
+            # ship unclear moment semantics (VERDICT r4 item 5).
+            raise ValueError(
+                "model.fused_blocks on a multi-chip data axis requires "
+                "model.sync_bn=false (per-replica BN via shard_map — the "
+                "reference's BN semantics); global-batch sync-BN is not "
+                "implemented for the fused kernels")
+        base_step = make_train_step(model, cfg.optim, schedule,
+                                    cfg.data.num_classes, augment_fn,
+                                    base_rng=step_rng, mesh=mesh,
+                                    grad_axis="data" if per_replica_bn else None)
+
+        step = int(jax.device_get(state.step))
+        total = max_steps if max_steps is not None else cfg.train.train_steps
+
+        # Input edge: device-resident (whole split in HBM, batches cut
+        # on-device, multi-step dispatch) when it applies, else the streaming
+        # host pipeline.
+        resident = device_data.should_use(cfg.data)
+        host_iter = None
+        if resident:
+            import tpu_resnet.data as data_lib
+
+            images_np, labels_np = data_lib.load_split(cfg.data, train=True)
+            ds = device_data.DeviceDataset(mesh, images_np, labels_np,
+                                           cfg.train.global_batch_size,
+                                           seed=cfg.train.seed)
+            run_chunk = device_data.compile_resident_steps(
+                base_step, ds, mesh, max(1, cfg.train.steps_per_call),
+                per_replica_bn=per_replica_bn)
+            data_iter = None
+        else:
+            data_iter, stage, host_iter = build_train_iterator(
+                cfg, mesh, start_step=step, injector=injector,
+                stop_event=shutdown.event)
+            if stage > 1:
+                run_staged = device_data.compile_staged_stream_steps(
+                    base_step, mesh, per_replica_bn=per_replica_bn)
+            else:
+                train_step = shard_step(base_step, mesh,
+                                        per_replica_bn=per_replica_bn)
+
+        meter = ThroughputMeter(cfg.train.global_batch_size,
+                                num_chips=mesh.size)
+        log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
+                 "global batch %d | input %s", cfg.model.name, cfg.data.dataset,
+                 total, n_params / 1e6, dict(mesh.shape),
+                 cfg.train.global_batch_size,
+                 "device-resident" if resident else "streaming")
+
+        profiling.maybe_start_server(cfg.train.profiler_port)
+        tracer = profiling.StepTracer(cfg.train.train_dir,
+                                      cfg.train.profile_steps, spans=spans)
+
+        # Step-time breakdown (tpu_resnet/obs/breakdown.py): data_wait /
+        # dispatch / sampled device backlog per log interval, compile time of
+        # the first dispatch reported separately. Sampling reuses the existing
+        # log boundaries (chunks already end exactly there), so it never
+        # changes fusion behavior.
+        breakdown = obs.StepBreakdown()
+        telemetry.heartbeat(step)
+        run_wall0 = time.time()
+        start_step = step
+        last_ckpt_step = step  # resumed or fresh: the last synced point
+        first_dispatch = True
+
+        meter.rate(step)
+        last_summary = step
+        last_sync = step  # last step the host fully drained the device at
+        m = None  # metrics of the newest dispatched chunk
+        stage_buf = None  # current streaming superbatch: (gi, gl, k, offset)
+        # Raw input images for the image-summary channel (reference
+        # cifar_input.py:118): the resident split's head, or the newest
+        # streamed batch; augmented at write time so the summary shows what
+        # the model actually saw.
+        last_inputs = images_np[:4] if resident else None
         while step < total:
+            injector.maybe_sigterm(step)
+            if shutdown.requested:
+                break  # stop at the chunk boundary; final save below
             tracer.before(step)
             if resident:
                 k = _chunk_len(step, total, cfg.train, ds.steps_per_epoch,
@@ -241,7 +289,12 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             elif stage > 1:
                 if stage_buf is None:
                     with breakdown.data_wait():
-                        gi, gl, k = next(data_iter)
+                        try:
+                            gi, gl, k = next(data_iter)
+                        except StopIteration:
+                            if shutdown.requested:
+                                break  # preempted mid-data-wait: save below
+                            raise
                     stage_buf = (gi, gl, k, 0)
                 gi, gl, k, off = stage_buf
                 # Fuse up to the stage end, clipped to the next log/summary/
@@ -258,11 +311,18 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 stage_buf = None if off >= k else (gi, gl, k, off)
             else:
                 with breakdown.data_wait():
-                    images, labels = next(data_iter)
+                    try:
+                        images, labels = next(data_iter)
+                    except StopIteration:
+                        if shutdown.requested:
+                            break  # preempted mid-data-wait: save below
+                        raise
                 with breakdown.dispatch():
                     state, m = train_step(state, images, labels)
                 step += 1
                 last_inputs = images
+            if watchdog is not None:
+                watchdog.progress(step)
             if tracer.after(step, sync=m):
                 # Closing a trace window drains the device mid-interval:
                 # the backlog the next boundary sample sees only covers
@@ -286,6 +346,39 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 breakdown.sample_device(m, step - last_sync)
                 m = {k: float(v) for k, v in jax.device_get(m).items()}
                 last_sync = step
+                if sentinel.check(step, m["loss"]):
+                    # Divergence rollback: restore the last checkpoint and
+                    # (streaming path) advance the data stream past the bad
+                    # window so the replayed steps see fresh batches. The
+                    # check reuses this boundary's host-synced metrics —
+                    # zero extra device syncs, fusion/chunking unchanged.
+                    ckpt.wait()
+                    if ckpt.latest_step() is None:
+                        raise sentinel.no_checkpoint(step, m["loss"])
+                    bad_step = step
+                    state = ckpt.restore(state, discard_failed=True)
+                    step = int(jax.device_get(state.step))
+                    spans.event("nan_rollback", from_step=bad_step,
+                                to_step=step, loss=str(m["loss"]),
+                                retry=sentinel.rollbacks)
+                    telemetry.set("fault_nan_rollbacks", sentinel.rollbacks)
+                    if not resident:
+                        # The stream is deterministic in (seed, step):
+                        # restart it at bad_step so steps (to_step,
+                        # bad_step] consume the batches *after* the bad
+                        # window instead of replaying it.
+                        host_iter.close()
+                        data_iter, stage, host_iter = build_train_iterator(
+                            cfg, mesh, start_step=bad_step,
+                            injector=injector, stop_event=shutdown.event)
+                        stage_buf = None
+                    m = None
+                    breakdown.reset_interval()
+                    meter.rate(step)  # re-prime the throughput baseline
+                    last_sync = step
+                    last_ckpt_step = step
+                    telemetry.heartbeat(step)
+                    continue
                 rate = meter.rate(step)
                 if rate:
                     m.update(rate)
@@ -313,9 +406,35 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 aug = augment_fn(jax.random.PRNGKey(step), jnp.asarray(raw))
                 metrics.write_images(step, jax.device_get(aug))
             if step % cfg.train.checkpoint_every == 0 or step == total:
-                if ckpt.save(step, state):
+                # A checkpoint boundary that is NOT a log boundary hasn't
+                # had its loss checked (possible when checkpoint_every is
+                # not a multiple of log_every): never persist NaN state —
+                # it would become the rollback target. The scalar read
+                # piggybacks on the save's own full-state sync, so this
+                # adds no standalone device sync.
+                if (sentinel.enabled and m is not None
+                        and step % cfg.train.log_every != 0
+                        and not math.isfinite(
+                            float(jax.device_get(m["loss"])))):
+                    log.warning("skipping checkpoint save at step %d: "
+                                "non-finite loss — rollback engages at "
+                                "the next log boundary", step)
+                    spans.event("checkpoint_save_skipped_nonfinite",
+                                step=step)
+                elif ckpt.save(step, state):
                     last_ckpt_step = step
                     telemetry.set("checkpoint_lag_steps", 0)
+        if shutdown.requested and step < total:
+            # Preemption honored at the chunk boundary: force a final save
+            # so the resume loses zero steps, then mark the event. The
+            # Preempted raise (the supervisor's distinct exit code) happens
+            # after the closer chain below has shut telemetry down cleanly.
+            log.warning("preemption stop at step %d — saving a final "
+                        "checkpoint before exit", step)
+            spans.event("preempt_stop", step=step, signum=shutdown.signum)
+            telemetry.set("fault_preemptions", 1.0)
+            if step > last_ckpt_step and ckpt.save(step, state, force=True):
+                last_ckpt_step = step
     finally:
         # One shutdown path for clean exits AND exceptions. Each closer
         # runs even if an earlier one raises (a failed ckpt.wait must not
@@ -334,15 +453,47 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 log.warning("shutdown closer %s failed: %s",
                             getattr(fn, "__name__", fn), e)
 
-        _close(lambda: tracer.close(sync=m))
-        _close(ckpt.wait)
-        _close(lambda: spans.record(
-            "run", run_wall0, time.time(), start_step=start_step,
-            stop_step=step, train_steps=total))
+        exc_type = sys.exc_info()[0]
+        if (rcfg.emergency_save and exc_type is not None
+                and ckpt is not None
+                and not issubclass(exc_type, (resilience.DivergenceError,
+                                              KeyboardInterrupt))
+                and step > last_ckpt_step):
+            # In-flight exception with unsaved progress: one guarded
+            # best-effort save, so the crash loses at most the current
+            # interval. Excluded: DivergenceError (the live state is NaN —
+            # persisting it would poison the resume) and an operator's
+            # escalated abort (they asked for NOW, not a slow save).
+            def _emergency_save():
+                if ckpt.save(step, state, force=True):
+                    spans.event("emergency_save", step=step)
+                    log.warning("emergency checkpoint saved at step %d "
+                                "after in-flight %s", step,
+                                exc_type.__name__)
+
+            _close(_emergency_save)
+        if tracer is not None:
+            _close(lambda: tracer.close(sync=m))
+        if ckpt is not None:
+            _close(ckpt.wait)
+        if run_wall0 is not None:  # the loop actually started
+            _close(lambda: spans.record(
+                "run", run_wall0, time.time(), start_step=start_step,
+                stop_step=step, train_steps=total))
         _close(spans.close)
         if server is not None:
             _close(server.close)
-        _close(metrics.close)
+        if metrics is not None:
+            _close(metrics.close)
+        if host_iter is not None:
+            _close(host_iter.close)
+        if watchdog is not None:
+            _close(watchdog.close)
+        if shutdown is not None:
+            _close(shutdown.uninstall)
         if closer_errs and sys.exc_info()[0] is None:
             raise closer_errs[0]
+    if shutdown is not None and shutdown.requested \
+            and total is not None and step < total:
+        raise resilience.Preempted(step, state=state, signum=shutdown.signum)
     return state
