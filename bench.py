@@ -79,6 +79,18 @@ _PEAK_FLOPS = [
 ]
 
 
+def _print_line(text: str) -> None:
+    """Emit one stdout line as a SINGLE write + flush. ``print`` may split
+    string and newline across writes, so a SIGKILL could land between them
+    and leave a complete-looking line that is actually mid-record; a
+    single small write is atomic on pipes (< PIPE_BUF), so a killed
+    emitter leaves either the whole line or a truncated one the salvage
+    parser (`_parse_result`) skips — never a corrupt-but-parseable one
+    (round-4 postmortem: BENCH_r04 captured rc=124 with parsed=null)."""
+    sys.stdout.write(text + "\n")
+    sys.stdout.flush()
+
+
 def _peak_flops(device_kind: str):
     env = os.environ.get("BENCH_PEAK_FLOPS")
     if env:
@@ -363,28 +375,18 @@ def _synthetic_photo_jpeg(size=(640, 480), quality=90, rng=None,
     """A photo-like test JPEG: smooth structure + mild noise compresses
     ~10:1 like real ImageNet photos. (Uniform noise — the old test image —
     is the pathological worst case: ~1.5:1, entropy-decode-bound, and made
-    every decode-path optimization invisible.) Shared by the host-decode
-    bench and tools/input_edge.py so both measurements rest on the same
-    entropy premise."""
-    import io
+    every decode-path optimization invisible.) Canonical implementation
+    lives with the data engine (tpu_resnet/data/engine.py) so the bench,
+    ``doctor --data-bench`` and tools/input_edge.py rest on the same
+    entropy premise; this name is kept as the tools' import point."""
+    from tpu_resnet.data.engine import synthetic_photo_jpeg
 
-    import numpy as np
-    from PIL import Image
-
-    if rng is None:
-        rng = np.random.default_rng(0)
-    xs = np.linspace(0, freqs[0] * np.pi, size[0])
-    ys = np.linspace(0, freqs[1] * np.pi, size[1])
-    base = (np.sin(xs)[None, :, None] * np.cos(ys)[:, None, None] * 0.5
-            + 0.5) * 255
-    arr = (base + rng.integers(0, 30, (size[1], size[0], 3))).clip(
-        0, 255).astype(np.uint8)
-    buf = io.BytesIO()
-    Image.fromarray(arr).save(buf, "JPEG", quality=quality)
-    return buf.getvalue()
+    return synthetic_photo_jpeg(size=size, quality=quality, rng=rng,
+                                freqs=freqs)
 
 
-def _measure_host_decode(n_images=200, size=(640, 480)):
+def _measure_host_decode(n_images=200, size=(640, 480), engine_curve=True,
+                         engine_secs=4.0):
     """Host-side JPEG decode + VGG preprocess throughput (images/s),
     native C++ (libjpeg-turbo partial decode + window resize) vs PIL, on
     the train path (random side 256-512 + random crop) and the eval path
@@ -413,6 +415,19 @@ def _measure_host_decode(n_images=200, size=(640, 480)):
     out["pil_images_per_sec"] = out["pil_train_images_per_sec"]
     out["native_speedup"] = round(
         out["native_images_per_sec"] / out["pil_images_per_sec"], 2)
+    if engine_curve:
+        # Process-engine worker-scaling curve (tpu_resnet/data/engine.py):
+        # the multiprocess answer to the GIL wall this section measured —
+        # BENCH_r04's 372 img/s single-host ceiling vs the chip's ~3032.
+        # Same probe as `doctor --data-bench`, so a bench line and an
+        # operator triage are directly comparable.
+        try:
+            from tpu_resnet.data.engine import decode_scaling_probe
+            cpus = os.cpu_count() or 1
+            out["engine_scaling"] = decode_scaling_probe(
+                proc_counts=(1, min(8, cpus)), seconds=engine_secs)
+        except Exception as e:  # the curve must never sink the section
+            out["engine_scaling_error"] = f"{type(e).__name__}: {e}"[:300]
     return out
 
 
@@ -538,7 +553,7 @@ def run_child(kind: str) -> None:
         snap = dict(result)
         if errors:
             snap["errors"] = dict(errors)
-        print("RESULT_JSON: " + json.dumps(snap), flush=True)
+        _print_line("RESULT_JSON: " + json.dumps(snap))
 
     if kind == "cpu":
         # Reduced counts: the CPU number is a liveness fallback, not a
@@ -850,7 +865,7 @@ def _emit(result: dict, cifar_sps, extra=None):
         cached = _cached_tpu_snapshot()
         if cached:
             line["cached_tpu_snapshot"] = _cached_summary(cached)
-    print(json.dumps(line), flush=True)
+    _print_line(json.dumps(line))
 
 
 def _clip(s: str, limit: int = 500) -> str:
@@ -880,12 +895,14 @@ def _completeness(result):
     return len([k for k in result if k not in meta])
 
 
-def _emit_tpu(result, rc, how_died):
+def _emit_tpu(result, rc, how_died, provisional=False):
     result = _salvage(dict(result), rc, how_died)
     # Measurement-time stamp, carried into archived artifacts so cached
     # emits can report when the number was captured (not a file mtime).
     result.setdefault("captured_at", time.strftime(
         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    if provisional:
+        result["provisional"] = True
     cifar = result.pop("cifar", {})
     if len(cifar) > 1:  # keep per-k detail beside the headline
         result["cifar_detail"] = cifar
@@ -1068,6 +1085,13 @@ def main():
                   file=sys.stderr)
             if not best or score > best[0]:
                 best = (score, result, rc, how)
+                # Put the new best on stdout NOW as a provisional line: a
+                # driver whose timeout fires during the NEXT attempt still
+                # captures these completed sections as its last parseable
+                # record (the final emit, printed last, supersedes).
+                _emit_tpu(best[1], best[2],
+                          best[3] + "; retrying while window remains",
+                          provisional=True)
         # Space out child retries: a fast-crashing child (probe ok,
         # init dies in seconds) must not burn every attempt in the first
         # two minutes of the budget.

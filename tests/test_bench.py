@@ -131,9 +131,75 @@ def test_parse_result_skips_truncated_final_snapshot():
 
 
 def test_measure_host_decode():
-    out = bench._measure_host_decode(n_images=20, size=(320, 240))
+    # engine_curve=False: the worker-scaling probe is covered by
+    # test_doctor's data-bench test (same probe function); spawning
+    # processes twice per suite buys nothing.
+    out = bench._measure_host_decode(n_images=20, size=(320, 240),
+                                     engine_curve=False)
     assert out["native_images_per_sec"] > 0
     assert out["pil_images_per_sec"] > 0
+    assert "engine_scaling" not in out
+
+
+def test_measure_host_decode_engine_curve_key(monkeypatch):
+    """With the curve enabled the section carries the probe result (or an
+    explicit error key — never a sunk section)."""
+    import tpu_resnet.data.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "decode_scaling_probe",
+                        lambda **kw: {"engine_images_per_sec_by_procs":
+                                      {"1": 10.0}})
+    out = bench._measure_host_decode(n_images=5, size=(320, 240),
+                                     engine_curve=True)
+    assert out["engine_scaling"]["engine_images_per_sec_by_procs"] == \
+        {"1": 10.0}
+
+    def boom(**kw):
+        raise RuntimeError("no procs here")
+
+    monkeypatch.setattr(engine_mod, "decode_scaling_probe", boom)
+    out = bench._measure_host_decode(n_images=5, size=(320, 240),
+                                     engine_curve=True)
+    assert "engine_scaling" not in out
+    assert "no procs here" in out["engine_scaling_error"]
+
+
+def test_sigkilled_child_mid_section_still_salvageable(tmp_path, capsys):
+    """Satellite (round-4 postmortem): a child SIGKILLed while *printing*
+    a section snapshot leaves at worst a truncated final line; the parent
+    must salvage the previous complete snapshot — a driver kill at any
+    instant always leaves parseable output. This drives a REAL process
+    killed mid-write through the real _run/_parse_result/_salvage path."""
+    import json
+    import signal
+    import sys
+    import textwrap
+
+    fake_child = tmp_path / "fake_child.py"
+    fake_child.write_text(textwrap.dedent("""
+        import json, os, signal, sys
+        sys.path.insert(0, %r)
+        from bench import _print_line
+        _print_line("RESULT_JSON: " + json.dumps(
+            {"backend": "tpu", "cifar": {"steps_per_sec": 7.0}}))
+        # next section: start emitting, SIGKILL self mid-write — flush a
+        # deliberately unterminated prefix first so the cut is mid-line
+        sys.stdout.write("RESULT_JSON: {\\"backend\\": \\"tpu\\", \\"cif")
+        sys.stdout.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    """ % bench.os.path.dirname(bench.os.path.abspath(bench.__file__))))
+    rc, out = bench._run([sys.executable, str(fake_child)],
+                         dict(bench.os.environ), timeout=60)
+    assert rc == -signal.SIGKILL
+    result = bench._parse_result(out)
+    assert result == {"backend": "tpu", "cifar": {"steps_per_sec": 7.0}}
+    salvaged = bench._salvage(result, rc, f"tpu child rc={rc}")
+    assert salvaged["partial"] is True
+    # and the parent-side emit of the salvage is itself one parseable line
+    cifar = salvaged.pop("cifar")
+    bench._emit(salvaged, cifar["steps_per_sec"])
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 7.0 and line["partial"] is True
 
 
 def test_measure_record_split():

@@ -71,3 +71,23 @@ def test_doctor_dataset_layout_imagenet(tmp_path):
     (tmp_path / "train-00000-of-00001").write_bytes(b"")
     (tmp_path / "validation-00000-of-00001").write_bytes(b"")
     assert doctor._check_dataset("imagenet", str(tmp_path))["ok"]
+
+
+import pytest
+
+
+@pytest.mark.slow  # spawns 3 decode processes (~10s); the probe's
+# plumbing into bench is covered in the default tier via monkeypatch
+def test_doctor_data_bench_probe():
+    """--data-bench: the decode scaling probe reports rates at 1 and N
+    worker processes plus the implied sustainable step rate (tiny probe
+    window here; the real flag runs ~4s per point)."""
+    out = doctor._check_data_bench(seconds=0.6)
+    assert out["ok"], out
+    rates = out["engine_images_per_sec_by_procs"]
+    assert "1" in rates and len(rates) >= 1
+    assert all(v > 0 for v in rates.values())
+    assert out["single_process_images_per_sec"] > 0
+    assert out["implied_max_steps_per_sec_b128"] > 0
+    from tpu_resnet.data import shm_ring
+    assert shm_ring.leaked_segments() == ()

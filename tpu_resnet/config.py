@@ -53,6 +53,24 @@ class DataConfig:
     # Number of worker threads in the host loader (reference uses 16 queue
     # threads, cifar_input.py:99-100; and num_parallel_calls=4 tf.data maps).
     num_workers: int = 4
+    # Host data engine worker kind for CPU-heavy sources (ImageNet JPEG
+    # decode; data/engine.py). "thread" keeps decode in-process — fine
+    # when the native GIL-free decoder carries the load, and the only
+    # sensible choice for in-memory CIFAR (which bypasses the engine
+    # entirely). "process" runs N decode *processes* over a shared-memory
+    # ring — the fix when the step breakdown shows data_wait high and
+    # host decode is the ceiling (BENCH_r04: one v5e consumes ~3032
+    # img/s at b128 while the GIL-bound host decoded ~372).
+    engine: str = "thread"  # thread | process
+    # Decode worker processes when data.engine=process (0 = num_workers).
+    num_decode_procs: int = 0
+    # Engine ring slots — batch-sized decode targets preallocated up
+    # front (shared memory in process mode). 0 = auto: hold window +
+    # 3*workers + 2 (~3 orders in flight per worker; thinner rings
+    # starve workers — see engine.py). RAM = slots × batch bytes
+    # (b128@224 ≈ 19 MB/slot); hold covers the staged-transfer
+    # look-back (transfer_stage + 1).
+    ring_slots: int = 0
     # Batches buffered ahead on host + device (prefetch 2x in reference,
     # resnet_cifar_train.py:233).
     prefetch: int = 2

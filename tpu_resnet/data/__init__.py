@@ -1,11 +1,9 @@
-from tpu_resnet.data.augment import get_augment_fns
-from tpu_resnet.data.cifar import load_cifar, load_split, synthetic_data
-from tpu_resnet.data.pipeline import (
-    BackgroundIterator,
-    ShardedBatcher,
-    device_prefetch,
-    eval_batches,
-)
+"""Data-layer package. Re-exports resolve LAZILY (PEP 562): the engine's
+spawned decode workers import ``tpu_resnet.data.engine`` (running this
+``__init__`` as its parent package), and an eager ``pipeline``/``augment``
+import here would drag a full jax import — seconds of spawn latency and
+hundreds of MB RSS — into every worker process that only needs
+numpy/PIL/the native loader."""
 
 __all__ = [
     "get_augment_fns",
@@ -18,18 +16,58 @@ __all__ = [
     "eval_batches",
     "train_batches",
     "eval_split_batches",
+    "engine_workers",
 ]
+
+_LAZY = {
+    "get_augment_fns": "tpu_resnet.data.augment",
+    "load_cifar": "tpu_resnet.data.cifar",
+    "load_split": "tpu_resnet.data.cifar",
+    "synthetic_data": "tpu_resnet.data.cifar",
+    "BackgroundIterator": "tpu_resnet.data.pipeline",
+    "ShardedBatcher": "tpu_resnet.data.pipeline",
+    "device_prefetch": "tpu_resnet.data.pipeline",
+    "eval_batches": "tpu_resnet.data.pipeline",
+}
+
+
+def __getattr__(name):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value  # cache: __getattr__ fires once per name
+    return value
+
+
+def engine_workers(data_cfg) -> int:
+    """Decode worker count for the configured engine mode."""
+    if data_cfg.engine == "process":
+        return data_cfg.num_decode_procs or data_cfg.num_workers
+    return data_cfg.num_workers
 
 
 def train_batches(data_cfg, local_batch: int, seed: int = 0,
-                  start_step: int = 0):
+                  start_step: int = 0, *, hold: int = 2,
+                  external_stop=None):
     """Per-dataset training batch iterator (host side, per-process shard),
-    yielding (uint8 images, int32 labels)."""
+    yielding (uint8 images, int32 labels).
+
+    ImageNet returns a :class:`tpu_resnet.data.engine.HostDataEngine`
+    (mode per ``data_cfg.engine``): already backgrounded with its own
+    ring prefetch, owns ``close()``, and yields ring *views* valid for
+    ``hold - 1`` further draws — callers must NOT wrap it in another
+    buffering layer (a queue holding more than ``hold`` references would
+    alias recycled slots). In-memory datasets return a plain iterator the
+    caller backgrounds as before."""
     import jax
 
     if data_cfg.dataset == "imagenet":
         from tpu_resnet.data.imagenet import ImageNetIterator
-        return iter(ImageNetIterator(
+        it = ImageNetIterator(
             data_cfg.data_dir, local_batch, train=True, seed=seed,
             num_workers=data_cfg.num_workers,
             shuffle_buffer=min(data_cfg.shuffle_buffer, 65536),
@@ -39,7 +77,14 @@ def train_batches(data_cfg, local_batch: int, seed: int = 0,
             process_count=jax.process_count(),
             image_size=data_cfg.resolved_image_size,
             verify_records=data_cfg.verify_records,
-            use_native=data_cfg.use_native_loader))
+            use_native=data_cfg.use_native_loader)
+        return it.engine(mode=data_cfg.engine,
+                         workers=engine_workers(data_cfg),
+                         ring_slots=data_cfg.ring_slots, hold=hold,
+                         external_stop=external_stop)
+    from tpu_resnet.data.cifar import load_split
+    from tpu_resnet.data.pipeline import ShardedBatcher
+
     images, labels = load_split(data_cfg, train=True)
     return iter(ShardedBatcher(images, labels, local_batch, seed=seed,
                                start_step=start_step))
@@ -61,6 +106,23 @@ def eval_split_batches(data_cfg, batch: int,
     pi = jax.process_index() if process_index is None else process_index
     pc = jax.process_count() if process_count is None else process_count
     if data_cfg.dataset == "imagenet":
+        if data_cfg.engine == "process":
+            # Process-decoded eval: same engine, sequential finite order
+            # (reassembly by sequence number keeps the pass exact for any
+            # worker count). The stream auto-closes at exhaustion; early
+            # abandoners must call .close() (the evaluator does).
+            from tpu_resnet.data.imagenet import ImageNetIterator
+            it = ImageNetIterator(
+                data_cfg.data_dir, batch, train=False,
+                process_index=pi, process_count=pc,
+                num_workers=data_cfg.num_workers,
+                image_size=data_cfg.resolved_image_size,
+                eval_resize=data_cfg.eval_resize,
+                verify_records=data_cfg.verify_records,
+                use_native=data_cfg.use_native_loader)
+            return it.engine(mode="process",
+                             workers=engine_workers(data_cfg),
+                             ring_slots=data_cfg.ring_slots)
         from tpu_resnet.data.imagenet import eval_examples
         return eval_examples(data_cfg.data_dir, batch,
                              process_index=pi, process_count=pc,
@@ -68,5 +130,8 @@ def eval_split_batches(data_cfg, batch: int,
                              eval_resize=data_cfg.eval_resize,
                              verify_records=data_cfg.verify_records,
                              use_native=data_cfg.use_native_loader)
+    from tpu_resnet.data.cifar import load_split
+    from tpu_resnet.data.pipeline import eval_batches
+
     images, labels = load_split(data_cfg, train=False)
     return eval_batches(images[pi::pc], labels[pi::pc], batch)

@@ -18,8 +18,12 @@ Reference behavior being matched (file:line):
   eval = resize to side 256 then central crop (:317-333). The flip and
   mean-subtraction run on-device (tpu_resnet.data.augment).
 - Parallel decode: ``num_parallel_calls`` map threads
-  (resnet_imagenet_train.py:170-171) → a thread pool here (PIL releases
-  the GIL for JPEG decode).
+  (resnet_imagenet_train.py:170-171) → the host data engine here
+  (tpu_resnet/data/engine.py): sequence-numbered per-batch work orders
+  over **positions** (file, offset, length), decoded by thread or process
+  workers into a preallocated slot ring. Batch order and contents are a
+  pure function of (seed, step) — independent of worker count, mode and
+  resume point.
 
 Unlike the reference — where every worker reads all 1024 shards and
 "shards" by independent shuffling (SURVEY.md §2.3) — shard files are
@@ -32,9 +36,7 @@ from __future__ import annotations
 import glob
 import io
 import os
-import queue
-import threading
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -157,8 +159,24 @@ def decode_and_crop(jpeg: bytes, train: bool, rng: np.random.Generator,
 
 
 class ImageNetIterator:
-    """Streaming train iterator: files striped per process, epoch-shuffled
-    record buffer, thread-pool JPEG decode, fixed-size uint8 batches."""
+    """Streaming iterator: files striped per process, epoch-shuffled
+    record buffer, engine-decoded fixed-size uint8 batches.
+
+    The iterator owns the *order* of the stream — per-epoch file shuffle,
+    the reservoir shuffle buffer, the resume skip — all computed over
+    cheap ``(file, record#)`` positions (the old payload-carrying buffer
+    held up to ``shuffle_buffer`` whole JPEGs in RAM and needed an
+    elaborate payload-free replay just to resume). Decoding is delegated
+    to :class:`tpu_resnet.data.engine.HostDataEngine` via per-batch work
+    orders, which is what makes the stream deterministic for any worker
+    count: the old thread pool raced on a shared ``next(rec_iter)`` and
+    admitted its batch order was nondeterministic.
+
+    ``__iter__`` yields **views** into the engine's slot ring, valid for
+    the following ``hold - 1`` draws (default hold 2: the current batch
+    is always safe); copy to retain longer. Consumers that need engine
+    lifecycle control (close, stats, process workers) call
+    :meth:`engine` directly."""
 
     def __init__(self, data_dir: str, local_batch: int, *, train: bool = True,
                  seed: int = 0, num_workers: int = 4,
@@ -184,24 +202,7 @@ class ImageNetIterator:
         self.verify_records = verify_records
         self.use_native = use_native
         self._findex: dict = {}
-        self._read_f = None
-        self._read_path = None
 
-    def _records(self) -> Iterator[Tuple[bytes, int]]:
-        epoch = 0
-        while True:
-            files = (self._epoch_files(epoch) if self.train
-                     else list(self.files))
-            for f in files:
-                for rec in read_shard_records(
-                        f, use_native=self.use_native,
-                        verify_crc=self.verify_records):
-                    yield rec
-            if not self.train:
-                return
-            epoch += 1
-
-    # -------------------------------------------------- resume fast-forward
     def _file_index(self, path: str):
         """Cached seek-only (offset, length) index of one shard."""
         if path not in self._findex:
@@ -209,41 +210,35 @@ class ImageNetIterator:
         return self._findex[path]
 
     def _epoch_files(self, epoch: int) -> List[str]:
-        """Per-epoch shard order — pure function of (seed, epoch), shared
-        by ``_records`` and the resume fast-forward."""
+        """Per-epoch shard order — pure function of (seed, epoch); the
+        backbone ``_position_stream`` (and through it ``work_orders``)
+        rides on."""
         files = list(self.files)
         np.random.default_rng((self.seed, epoch)).shuffle(files)
         return files
 
-    def _read_at(self, path: str, idx: int) -> bytes:
-        """Random-access one record payload (sequential in practice: the
-        position stream visits files in order, so this keeps one shard
-        open and seeks forward within it). Honors ``verify_records`` so
-        the resume path has the same corruption guarantee as bulk reads."""
-        import struct
+    def _position_stream(self) -> Iterator[Tuple[str, int]]:
+        """(file, record#) visit order — the deterministic backbone both
+        the shuffle and the work orders ride on. Infinite (epoch-cycled)
+        for train, one pass for eval."""
+        epoch = 0
+        while True:
+            files = (self._epoch_files(epoch) if self.train
+                     else list(self.files))
+            for f in files:
+                for i in range(len(self._file_index(f))):
+                    yield f, i
+            if not self.train:
+                return
+            epoch += 1
 
-        if self._read_path != path:
-            if self._read_f is not None:
-                self._read_f.close()
-            self._read_f = open(path, "rb")
-            self._read_path = path
-        off, length = self._file_index(path)[idx]
-        self._read_f.seek(off)
-        payload = self._read_f.read(length)
-        if self.verify_records:
-            (want,) = struct.unpack("<I", self._read_f.read(4))
-            if tfrecord.masked_crc32c_fast(payload) != want:
-                raise ValueError(f"{path}: record {idx} CRC mismatch")
-        return payload
-
-    def _shuffle_stream(self, records: Iterator[bytes],
-                        rng: np.random.Generator,
-                        buf: List[bytes]) -> Iterator[bytes]:
+    def _shuffle_stream(self, items: Iterator, rng: np.random.Generator,
+                        buf: List) -> Iterator:
         """Reservoir-style shuffle buffer (the reference's
-        ``shuffle(buffer_size=1024)``, resnet_imagenet_train.py:174-178),
-        resumable: ``rng`` and ``buf`` carry the mid-stream state."""
-        for rec in records:
-            buf.append(rec)
+        ``shuffle(buffer_size=1024)``, resnet_imagenet_train.py:174-178)
+        over arbitrary items — here cheap positions, never payloads."""
+        for item in items:
+            buf.append(item)
             if len(buf) >= self.shuffle_buffer:
                 idx = int(rng.integers(0, len(buf)))
                 buf[idx], buf[-1] = buf[-1], buf[idx]
@@ -253,145 +248,95 @@ class ImageNetIterator:
             buf[idx], buf[-1] = buf[-1], buf[idx]
             yield buf.pop()
 
-    def _shuffled_records(self) -> Iterator[bytes]:
-        """Shuffled record stream; with ``start_step > 0`` it continues
+    def _shuffled_positions(self) -> Iterator[Tuple[str, int]]:
+        """Shuffled position stream; with ``start_step > 0`` it continues
         *exactly* where an uninterrupted run's stream would be after
         ``start_step`` batches (reference resume contract,
         resnet_imagenet_train.py:267-270 — which the reference itself does
-        not honor for the input stream).
-
-        Fast-forward replays the shuffle-buffer algorithm over cheap
-        (file, record#) positions — identical RNG draws, no payload reads —
-        reconstructing the buffer contents and RNG state at the resume
-        point; only the ≤ ``shuffle_buffer`` records still in the buffer
-        are then fetched via the seek-only shard index."""
+        not honor for the input stream). Because the stream carries
+        positions, resume is a plain skip of already-consumed draws — no
+        payload reads, no replay machinery."""
         if not self.train:
-            yield from self._records()
+            yield from self._position_stream()
             return
         rng = np.random.default_rng((self.seed, 1))
-        if self.start_step <= 0:
-            yield from self._shuffle_stream(self._records(), rng, [])
-            return
-        skip = self.start_step * self.local_batch
-        # Explicit (epoch, file#, record#) cursor through the position
-        # stream, so the continuation below can resume with *bulk* shard
-        # reads — only the <= shuffle_buffer records reconstructed into the
-        # buffer (and the tail of the one partially-consumed shard) use
-        # indexed random access.
-        epoch, fi, ri = 0, 0, 0
-        files = self._epoch_files(0)
-        pos_buf: List[Tuple[str, int]] = []
-        emitted = 0
-        while emitted < skip:  # train stream is infinite → never drains
-            while ri >= len(self._file_index(files[fi])):
-                fi, ri = fi + 1, 0
-                if fi >= len(files):
-                    epoch, fi = epoch + 1, 0
-                    files = self._epoch_files(epoch)
-            pos_buf.append((files[fi], ri))
-            ri += 1
-            if len(pos_buf) >= self.shuffle_buffer:
-                idx = int(rng.integers(0, len(pos_buf)))
-                pos_buf[idx], pos_buf[-1] = pos_buf[-1], pos_buf[idx]
-                pos_buf.pop()
-                emitted += 1
-        buf = [self._read_at(f, i) for f, i in pos_buf]
+        stream = self._shuffle_stream(self._position_stream(), rng, [])
+        for _ in range(self.start_step * self.local_batch):
+            next(stream)  # infinite train stream: never drains
+        yield from stream
 
-        def rest() -> Iterator[bytes]:
-            e, f0, r0 = epoch, fi, ri
-            while True:
-                efiles = self._epoch_files(e) if e != epoch else files
-                for k in range(f0, len(efiles)):
-                    if r0:  # tail of the partially-consumed shard
-                        index = self._file_index(efiles[k])
-                        for i in range(r0, len(index)):
-                            yield self._read_at(efiles[k], i)
-                        r0 = 0
-                    else:  # whole shards go through the bulk reader
-                        yield from read_shard_records(
-                            efiles[k], use_native=self.use_native,
-                            verify_crc=self.verify_records)
-                e, f0 = e + 1, 0
+    def work_orders(self) -> Iterator[List[Tuple[int, int, int]]]:
+        """Pre-sliced per-batch record entries ``(file_idx, offset,
+        length)`` — the engine's task-queue payload. Batch ``i`` of this
+        stream is consumed at global step ``start_step + i``; contents
+        are a pure function of (seed, step)."""
+        fidx = {f: i for i, f in enumerate(self.files)}
+        batch: List[Tuple[int, int, int]] = []
+        for path, ri in self._shuffled_positions():
+            off, length = self._file_index(path)[ri]
+            batch.append((fidx[path], off, length))
+            if len(batch) == self.local_batch:
+                yield batch
+                batch = []
+        if batch:  # finite eval tail → partial order, engine zero-pads
+            yield batch
 
-        yield from self._shuffle_stream(rest(), rng, buf)
+    def engine(self, *, mode: str = "thread", workers: Optional[int] = None,
+               ring_slots: int = 0, hold: int = 2, external_stop=None):
+        """The decode engine for this stream (tpu_resnet/data/engine.py).
+        Callers own its lifecycle: ``close()`` releases workers and (in
+        process mode) unlinks the shared-memory ring."""
+        from tpu_resnet.data.engine import HostDataEngine
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         if Image is None:
             raise RuntimeError("PIL is required for ImageNet decoding")
-        rec_iter = self._shuffled_records()
-        lock = threading.Lock()
-        out_q: "queue.Queue" = queue.Queue(maxsize=4)
-        stop = threading.Event()
+        return HostDataEngine(
+            self.work_orders(), files=self.files,
+            local_batch=self.local_batch, image_size=self.image_size,
+            seed=self.seed, train=self.train,
+            resize_min=self.resize_min, resize_max=self.resize_max,
+            eval_resize=self.eval_resize,
+            verify_records=self.verify_records, use_native=self.use_native,
+            mode=mode, workers=workers or self.num_workers,
+            ring_slots=ring_slots, hold=hold, first_seq=self.start_step,
+            external_stop=external_stop)
 
-        def worker(widx: int):
-            rng = np.random.default_rng((self.seed, widx, self.start_step))
-            images = np.empty((self.local_batch, self.image_size,
-                               self.image_size, 3), np.uint8)
-            labels = np.empty((self.local_batch,), np.int32)
-            # Each worker builds whole batches to avoid cross-thread
-            # assembly; batch order across workers is nondeterministic but
-            # contents are seed-stable per worker.
-            while not stop.is_set():
-                count = 0
-                while count < self.local_batch:
-                    with lock:
-                        try:
-                            rec = next(rec_iter)
-                        except StopIteration:
-                            rec = None
-                    if rec is None:
-                        break
-                    jpeg, label = parse_record(rec)
-                    images[count] = decode_and_crop(
-                        jpeg, self.train, rng,
-                        self.resize_min, self.resize_max,
-                        eval_resize=self.eval_resize,
-                        out_size=self.image_size,
-                        use_native=self.use_native)
-                    labels[count] = label - 1  # 1-based shard labels → 0-based
-                    count += 1
-                if count == self.local_batch:
-                    out_q.put((images.copy(), labels.copy()))
-                else:
-                    break
-            out_q.put(None)
-
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                   for i in range(self.num_workers)]
-        for t in threads:
-            t.start()
-        finished = 0
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        eng = self.engine()
         try:
-            while finished < len(threads):
-                item = out_q.get()
-                if item is None:
-                    finished += 1
-                    continue
-                yield item
+            yield from eng
         finally:
-            stop.set()
-            # drain so workers blocked on put() can exit
-            while not out_q.empty():
-                out_q.get_nowait()
+            eng.close()
 
 
 def eval_examples(data_dir: str, batch: int, *,
                   process_index: int = 0, process_count: int = 1,
                   image_size: int = IMAGE_SIZE,
                   eval_resize: int = EVAL_RESIZE,
-                  verify_records: bool = False, use_native: bool = True
+                  verify_records: bool = False, use_native: bool = True,
+                  pool_slots: int = 4
                   ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Sequential eval pass with zero-padded final batch (labels=-1 mark
-    padding, mirroring pipeline.eval_batches)."""
+    padding, mirroring pipeline.eval_batches).
+
+    Yields from a small round-robin pool of preallocated batch buffers
+    instead of ``np.empty`` + ``.copy()`` per batch: a yielded pair stays
+    valid for the next ``pool_slots - 1`` batches, then its buffer is
+    reused. Every in-repo consumer (evaluator → immediate device upload,
+    predict → mask-indexed copies) is inside that window; copy to retain
+    longer."""
     files = shard_files(data_dir, train=False)[process_index::process_count]
     if not files:
         raise ValueError("fewer validation shard files than processes")
-    rng = np.random.default_rng(0)
-    images = np.empty((batch, image_size, image_size, 3), np.uint8)
-    labels = np.full((batch,), -1, np.int32)
-    count = 0
     if Image is None:
         raise RuntimeError("PIL is required for ImageNet decoding")
+    rng = np.random.default_rng(0)
+    pool = [(np.empty((batch, image_size, image_size, 3), np.uint8),
+             np.empty((batch,), np.int32))
+            for _ in range(max(2, pool_slots))]
+    slot = 0
+    images, labels = pool[slot]
+    count = 0
     for f in files:
         for rec in read_shard_records(f, use_native=use_native,
                                       verify_crc=verify_records):
@@ -403,9 +348,11 @@ def eval_examples(data_dir: str, batch: int, *,
             labels[count] = label - 1
             count += 1
             if count == batch:
-                yield images.copy(), labels.copy()
+                yield images, labels
+                slot = (slot + 1) % len(pool)
+                images, labels = pool[slot]
                 count = 0
-                labels[:] = -1
     if count:
         images[count:] = 0
-        yield images.copy(), labels.copy()
+        labels[count:] = -1
+        yield images, labels

@@ -103,7 +103,16 @@ def eval_batches(images: np.ndarray, labels: np.ndarray,
 class BackgroundIterator:
     """Runs an iterator in a daemon thread with a bounded queue — the analog
     of the reference's QueueRunner prefetching (cifar_input.py:99-100), one
-    thread being enough since augmentation moved on-device."""
+    thread being enough since augmentation moved on-device.
+
+    Right for sources that are cheap per item (in-memory CIFAR batch
+    slicing): one producer thread and a queue of owned arrays. CPU-heavy
+    sources (ImageNet JPEG decode) use its multi-worker generalization,
+    tpu_resnet/data/engine.py::HostDataEngine — N thread/process workers
+    over a preallocated slot ring with the same consumer-facing contract
+    (close(), external_stop, producer-death raises). Do NOT stack this on
+    top of an engine: the queue would hold more ring views than the
+    engine's recycle window allows."""
 
     def __init__(self, it: Iterator, capacity: int = 4,
                  external_stop: Optional[threading.Event] = None):

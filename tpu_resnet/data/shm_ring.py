@@ -1,0 +1,204 @@
+"""Preallocated ring of batch slots for the host data engine.
+
+The GIL-bound thread pool in the old ``ImageNetIterator.__iter__`` paid
+two taxes per batch: a full ``images.copy()`` on the producer side and —
+for any future *process* worker — a pickle of the whole decoded batch
+through a ``multiprocessing.Queue``. The ring removes both: workers decode
+**directly into** preallocated slots, and only tiny ``(seq, slot, count)``
+tuples cross the queue.
+
+Two backings with one interface:
+
+``ShmRing``    one ``multiprocessing.shared_memory`` segment sliced into
+               ``slots`` batch slots (images uint8 [B,H,W,3] + labels
+               int32 [B]). The **parent creates and unlinks**; workers
+               attach by name. Crash hygiene: every created segment is
+               registered in a module-level set and unlinked from an
+               ``atexit`` hook, so an exception path that misses
+               ``close()`` still leaves ``/dev/shm`` clean.
+``ArrayRing``  the same slot math over ordinary numpy arrays — the
+               thread-mode backing (no shared memory needed inside one
+               process), also the CPU-cheap choice for tests.
+
+Aliasing contract (shared with the engine): ``images(slot)``/
+``labels(slot)`` return **views**. A slot's views stay valid until the
+slot is recycled by the engine's hold window; consumers that need a batch
+beyond that window must copy.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import secrets
+import threading
+from multiprocessing import shared_memory
+from typing import List, Tuple
+
+import numpy as np
+
+SHM_PREFIX = "tpures_ring_"
+
+# Segments created by THIS process, unlinked on interpreter exit as a
+# crash backstop (the engine's close() is the normal path and removes the
+# entry here).  Guarded by a lock: train loop closers and atexit can race.
+_created: set = set()
+_created_lock = threading.Lock()
+
+
+def _atexit_unlink():
+    with _created_lock:
+        names = list(_created)
+        _created.clear()
+    for name in names:
+        try:
+            seg = shared_memory.SharedMemory(name=name)
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+
+
+atexit.register(_atexit_unlink)
+
+
+def _slot_nbytes(local_batch: int, image_size: int) -> int:
+    return local_batch * image_size * image_size * 3 + 4 * local_batch
+
+
+class ShmRing:
+    """``slots`` batch slots in one named shared-memory segment."""
+
+    def __init__(self, slots: int, local_batch: int, image_size: int,
+                 name: str = None, create: bool = True):
+        self.slots = int(slots)
+        self.local_batch = int(local_batch)
+        self.image_size = int(image_size)
+        self._slot_bytes = _slot_nbytes(local_batch, image_size)
+        nbytes = self.slots * self._slot_bytes
+        if create:
+            name = name or SHM_PREFIX + f"{os.getpid()}_{secrets.token_hex(4)}"
+            self._shm = shared_memory.SharedMemory(name=name, create=True,
+                                                   size=nbytes)
+            with _created_lock:
+                _created.add(name)
+        else:
+            self._shm = _attach_untracked(name)
+        self.name = self._shm.name
+        self._owner = create
+        self._img_shape = (local_batch, image_size, image_size, 3)
+        self._views_built = False
+        self._images: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+        self._build_views()
+
+    def _build_views(self):
+        buf = self._shm.buf
+        img_bytes = self.local_batch * self.image_size * self.image_size * 3
+        for s in range(self.slots):
+            base = s * self._slot_bytes
+            self._images.append(np.ndarray(
+                self._img_shape, dtype=np.uint8, buffer=buf,
+                offset=base))
+            self._labels.append(np.ndarray(
+                (self.local_batch,), dtype=np.int32, buffer=buf,
+                offset=base + img_bytes))
+        self._views_built = True
+
+    def images(self, slot: int) -> np.ndarray:
+        return self._images[slot]
+
+    def labels(self, slot: int) -> np.ndarray:
+        return self._labels[slot]
+
+    def close(self):
+        """Worker-side release of the mapping (no unlink)."""
+        self._drop_views()
+        try:
+            self._shm.close()
+        except BufferError:  # a consumer still holds a view — the mapping
+            pass             # is reclaimed when the last view is GC'd
+
+    def unlink(self):
+        """Parent-side teardown: remove the name from /dev/shm. Safe to
+        call twice; the mapping itself is released when the last view
+        drops (``close`` above tolerates live exports)."""
+        with _created_lock:
+            _created.discard(self.name)
+        self.close()
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def _drop_views(self):
+        self._images = []
+        self._labels = []
+        self._views_built = False
+
+
+def _attach_untracked(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment WITHOUT registering it with this
+    process's resource tracker.
+
+    Python 3.10's tracker (bpo-38119) unlinks every shared-memory segment
+    a process ever attached to when that process exits — a worker that
+    finished its shard would tear the ring down under the parent. The
+    parent is the sole owner here; workers must attach untracked. (3.13+
+    exposes ``track=False`` for exactly this; this is the documented
+    workaround for older runtimes.)"""
+    from multiprocessing import resource_tracker
+
+    orig = resource_tracker.register
+    try:
+        resource_tracker.register = lambda *a, **kw: None
+        return shared_memory.SharedMemory(name=name)
+    finally:
+        resource_tracker.register = orig
+
+
+class ArrayRing:
+    """ShmRing's interface over plain numpy buffers — thread-mode backing
+    (one address space, nothing to share or unlink)."""
+
+    def __init__(self, slots: int, local_batch: int, image_size: int):
+        self.slots = int(slots)
+        self.local_batch = int(local_batch)
+        self.image_size = int(image_size)
+        self.name = None
+        self._images = [np.empty((local_batch, image_size, image_size, 3),
+                                 np.uint8) for _ in range(slots)]
+        self._labels = [np.empty((local_batch,), np.int32)
+                        for _ in range(slots)]
+
+    def images(self, slot: int) -> np.ndarray:
+        return self._images[slot]
+
+    def labels(self, slot: int) -> np.ndarray:
+        return self._labels[slot]
+
+    def close(self):
+        pass
+
+    def unlink(self):
+        pass
+
+
+def leaked_segments(pid: int = None) -> Tuple[str, ...]:
+    """Names of ring segments currently present in /dev/shm — the
+    cleanliness assertion the shm-hygiene tests and drills use.
+
+    Defaults to segments created by THIS process (the creator pid is
+    embedded in the name): /dev/shm is a host-global namespace, so an
+    unfiltered scan would report another process's legitimately-live ring
+    (e.g. two test suites running concurrently) as a "leak". Pass
+    ``pid=0`` for the unfiltered host-wide view."""
+    if pid is None:
+        pid = os.getpid()
+    prefix = SHM_PREFIX if pid == 0 else f"{SHM_PREFIX}{pid}_"
+    try:
+        return tuple(n for n in os.listdir("/dev/shm")
+                     if n.startswith(prefix))
+    except OSError:  # platform without /dev/shm: nothing to report
+        return ()

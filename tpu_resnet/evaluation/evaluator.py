@@ -79,17 +79,25 @@ def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn
 
     it = iter(data_lib.eval_split_batches(cfg.data, local_batch))
     correct = loss_sum = count = 0
-    while True:
-        nxt = next(it, None)
-        img, lab = nxt if nxt is not None else (pad_img, pad_lab)
-        gi, gl = pipeline.to_global_arrays((img, lab), sharding)
-        c, ls, n = eval_step_fn(state, gi, gl)
-        n = int(n)  # global valid count — identical on every process
-        if n == 0:
-            break
-        correct += int(c)
-        loss_sum += float(ls)
-        count += n
+    try:
+        while True:
+            nxt = next(it, None)
+            img, lab = nxt if nxt is not None else (pad_img, pad_lab)
+            gi, gl = pipeline.to_global_arrays((img, lab), sharding)
+            c, ls, n = eval_step_fn(state, gi, gl)
+            n = int(n)  # global valid count — identical on every process
+            if n == 0:
+                break
+            correct += int(c)
+            loss_sum += float(ls)
+            count += n
+    finally:
+        # data.engine=process hands back a HostDataEngine: release its
+        # workers and unlink the shared-memory ring even when the pass
+        # dies mid-split (it also auto-closes at clean exhaustion).
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
     return correct / max(count, 1), loss_sum / max(count, 1), count
 
 

@@ -93,6 +93,12 @@ def main(argv=None):
                                 "a temp train_dir (~30s tiny CPU run): "
                                 "preemption exit code, final checkpoint, "
                                 "exact-step resume")
+            p.add_argument("--data-bench", action="store_true",
+                           help="~20s synthetic-JPEG decode throughput "
+                                "probe: images/sec at 1 vs N decode "
+                                "processes + implied max steps/sec — "
+                                "tells host-bound from chip-bound "
+                                "without a full bench run")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -108,7 +114,8 @@ def main(argv=None):
                              train_dir=args.train_dir,
                              probe_timeout=args.probe_timeout,
                              mesh_devices=args.mesh_devices,
-                             fault_drill=args.fault_drill)
+                             fault_drill=args.fault_drill,
+                             data_bench=args.data_bench)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

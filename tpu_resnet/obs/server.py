@@ -51,6 +51,12 @@ CORE_GAUGES = (
     ("images_per_sec", "Global images per second over the last interval"),
     ("images_per_sec_per_chip", "Per-chip images per second"),
     ("data_wait_frac", "Fraction of interval wall time blocked on input"),
+    # Host data engine (tpu_resnet/data/engine.py) — the cause signal
+    # behind data_wait: occupancy 0 while waiting = producer-bound host.
+    ("data_ring_occupancy", "Decoded batches waiting in the engine ring"),
+    ("data_ring_slots", "Total engine ring slots"),
+    ("data_decode_images_per_sec",
+     "Host decode throughput over the last interval"),
     ("compile_seconds", "First-dispatch wall time (trace+compile+run)"),
     ("checkpoint_lag_steps", "Steps since the last checkpoint save"),
     # Fault counters (tpu_resnet/resilience) — pre-declared so a scrape on

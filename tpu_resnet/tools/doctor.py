@@ -23,6 +23,13 @@ Checks:
   telemetry  optional --train-dir scrape of the run's telemetry server
              (port from <train_dir>/telemetry.json): /metrics parses as
              Prometheus text and /healthz reports a fresh heartbeat
+  data_bench optional (--data-bench): ~20 s synthetic-JPEG decode
+             throughput probe — images/sec at 1 vs N worker processes
+             through the shared-memory data engine plus the implied max
+             sustainable steps/sec at global batch 128, so an operator
+             can tell host-bound from chip-bound without a full bench
+             run (the same probe backs bench.py's host_decode
+             worker-scaling curve)
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -160,6 +167,23 @@ def _check_telemetry(train_dir: str, timeout: float = 5.0) -> dict:
             "series": len(metrics)}
 
 
+def _check_data_bench(seconds: float = 4.0) -> dict:
+    """Host decode-throughput scaling probe (tpu_resnet/data/engine.py).
+    Healthy means the engine moved images at every probed worker count;
+    the numbers are the diagnosis: ``data_wait`` high in a run +
+    ``implied_max_steps_per_sec_b128`` below the chip's step rate =
+    host-bound — raise ``data.num_decode_procs`` (or the host count)."""
+    from tpu_resnet.data.engine import decode_scaling_probe
+
+    try:
+        probe = decode_scaling_probe(proc_counts=(1, 0), seconds=seconds)
+    except Exception as e:
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    rates = probe.get("engine_images_per_sec_by_procs", {})
+    ok = bool(rates) and all(v > 0 for v in rates.values())
+    return {"ok": ok, **probe}
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -201,7 +225,8 @@ def _check_fault_drill(timeout: int = 240) -> dict:
 
 def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                probe_timeout: int = 60, mesh_devices: int = 8,
-               fault_drill: bool = False, stream=None) -> dict:
+               fault_drill: bool = False, data_bench: bool = False,
+               data_bench_secs: float = 4.0, stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -225,6 +250,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if train_dir:
         summary["telemetry"] = _check_telemetry(train_dir)
         emit("telemetry", summary["telemetry"])
+    if data_bench:
+        summary["data_bench"] = _check_data_bench(seconds=data_bench_secs)
+        emit("data_bench", summary["data_bench"])
     if fault_drill:
         summary["fault_drill"] = _check_fault_drill()
         emit("fault_drill", summary["fault_drill"])

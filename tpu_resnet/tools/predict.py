@@ -87,15 +87,23 @@ def predict_from_export(cfg: RunConfig, export_dir: str, out_dir: str,
 
     all_images, all_labels, all_preds = [], [], []
     seen = 0
-    for images, labels in data_lib.eval_split_batches(cfg.data, chunk):
-        preds = bundle.predict(images)
-        valid = labels >= 0
-        all_images.append(images[valid])
-        all_labels.append(labels[valid])
-        all_preds.append(preds[valid])
-        seen += int(valid.sum())
-        if seen >= num_examples:
-            break
+    it = data_lib.eval_split_batches(cfg.data, chunk)
+    try:
+        for images, labels in it:
+            preds = bundle.predict(images)
+            valid = labels >= 0
+            all_images.append(images[valid])
+            all_labels.append(labels[valid])
+            all_preds.append(preds[valid])
+            seen += int(valid.sum())
+            if seen >= num_examples:
+                break
+    finally:
+        # data.engine=process returns a HostDataEngine; the early break
+        # above must not strand decode workers + the shared-memory ring.
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
     images = np.concatenate(all_images)[:num_examples]
     labels = np.concatenate(all_labels)[:num_examples]
     preds = np.concatenate(all_preds)[:num_examples]

@@ -48,28 +48,53 @@ def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0,
     ``(stage, B, ...)`` superbatches (one transfer each) plus their length;
     the loop fuses those steps into single dispatches.
 
-    Returns ``(device_iter, stage, host_iter)``; the ``host_iter``
-    (BackgroundIterator) handle lets the NaN-rollback path release the
-    producer thread before rebuilding the stream past the bad window.
-    ``injector`` (resilience.FaultInjector) wraps the host batch stream
-    with its planned data faults; a default (inactive) plan returns the
-    stream object untouched."""
+    Returns ``(device_iter, stage, host_iter)``; the ``host_iter`` handle
+    (HostDataEngine for ImageNet, BackgroundIterator otherwise) lets the
+    NaN-rollback path release the producers before rebuilding the stream
+    past the bad window, and joins the shutdown closer chain (engine
+    close unlinks its shared-memory ring). ``injector``
+    (resilience.FaultInjector) wraps the host batch stream with its
+    planned data faults; a default (inactive) plan returns the stream
+    object untouched."""
     import tpu_resnet.data as data_lib
+    from tpu_resnet.data.engine import HostDataEngine
 
     local_bs = parallel.local_batch_size(cfg.train.global_batch_size, mesh)
     stage = max(1, cfg.data.transfer_stage)
+    # hold = stage + 1: the staged superbatch assembly looks back at most
+    # `stage - 1` engine views while collecting one transfer's batches.
     batches = data_lib.train_batches(cfg.data, local_bs, seed=cfg.train.seed,
-                                     start_step=start_step)
-    if injector is not None:
-        batches = injector.wrap_host_batches(batches, start_step=start_step)
-    host_iter = pipeline.BackgroundIterator(
-        batches, capacity=stage * cfg.data.prefetch + 2,
-        external_stop=stop_event)
+                                     start_step=start_step, hold=stage + 1,
+                                     external_stop=stop_event)
+    if isinstance(batches, HostDataEngine):
+        # The engine is its own background prefetcher (ring slots ahead of
+        # the consumer) — wrapping it in BackgroundIterator would both
+        # stack a redundant thread AND buffer more ring views than the
+        # hold window allows. The fault injector's wrapper holds nothing.
+        host_iter = batches
+        stream = (injector.wrap_host_batches(batches, start_step=start_step)
+                  if injector is not None else batches)
+    else:
+        if injector is not None:
+            batches = injector.wrap_host_batches(batches,
+                                                 start_step=start_step)
+        host_iter = pipeline.BackgroundIterator(
+            batches, capacity=stage * cfg.data.prefetch + 2,
+            external_stop=stop_event)
+        stream = host_iter
     if stage > 1:
         return pipeline.staged_superbatch_prefetch(
-            host_iter, parallel.staged_batch_sharding(mesh),
+            stream, parallel.staged_batch_sharding(mesh),
             stage=stage, depth=cfg.data.prefetch), stage, host_iter
-    return pipeline.device_prefetch(host_iter, parallel.batch_sharding(mesh),
+    if isinstance(host_iter, HostDataEngine):
+        # Unstaged path: device_prefetch hands each batch straight to an
+        # ASYNC host→device transfer and keeps `depth` in flight — a ring
+        # view could be recycled (hold counts draws, not transfer
+        # completions) while PJRT is still reading it. Copy out of the
+        # ring here; the staged path needs no copy because np.stack
+        # materializes the superbatch synchronously.
+        stream = ((img.copy(), lab.copy()) for img, lab in stream)
+    return pipeline.device_prefetch(stream, parallel.batch_sharding(mesh),
                                     depth=cfg.data.prefetch), 1, host_iter
 
 
@@ -383,6 +408,11 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 if rate:
                     m.update(rate)
                 m.update(breakdown.interval())
+                if host_iter is not None and hasattr(host_iter, "stats"):
+                    # Engine cause-signal for data_wait: ring occupancy
+                    # (0 while the step waits = producer-bound) and the
+                    # interval decode rate.
+                    m.update(host_iter.stats())
                 telemetry.update(m)
                 telemetry.set("checkpoint_lag_steps", step - last_ckpt_step)
                 telemetry.heartbeat(step)
