@@ -1,0 +1,5 @@
+"""Pre-PR3 shape: an EAGER re-export in the worker's parent package —
+drags the full pipeline (and through it jax) into every spawned decode
+worker. The real tree resolves these lazily via PEP 562."""
+
+from tpu_resnet.data.pipeline import ShardedBatcher  # noqa: F401

@@ -1,0 +1,19 @@
+"""Worker entry module with two seeded fork-safety violations: the
+parent-package eager import reaches jax transitively, and the process
+pool uses the platform-default fork context."""
+
+import multiprocessing
+import threading
+
+from tpu_resnet.data import ShardedBatcher  # closure -> pipeline -> jax
+
+_pool_lock = threading.Lock()  # module-level lock in a worker module
+
+
+def start_workers(n):
+    ctx = multiprocessing.get_context("fork")  # fork after jax init
+    return [ctx.Process(target=_worker, args=(i,)) for i in range(n)]
+
+
+def _worker(i):
+    return ShardedBatcher([], []).images

@@ -1,0 +1,14 @@
+"""Host pipeline that imports jax at module scope — fine for the parent
+process, fatal for the worker import closure it leaked into."""
+
+import jax  # the violation the closure walk must surface
+import numpy as np
+
+
+class ShardedBatcher:
+    def __init__(self, images, labels):
+        self.images = np.asarray(images)
+        self.labels = np.asarray(labels)
+
+    def device_put(self):
+        return jax.device_put(self.images)

@@ -1,0 +1,13 @@
+"""build_model KEEPS its guard in this fixture — the parity rule must
+flag only the constructors that fail to mirror it."""
+
+from tpu_resnet.models.resnet import cifar_resnet_v2
+
+
+def build_model(cfg):
+    if cfg.model.fused_blocks and cfg.model.width_multiplier > 1:
+        raise ValueError("model.fused_blocks is only measured/tiled for "
+                         "width_multiplier=1")
+    return cifar_resnet_v2(cfg.model.resnet_size, cfg.data.num_classes,
+                           width_multiplier=cfg.model.width_multiplier,
+                           fused_blocks=cfg.model.fused_blocks)

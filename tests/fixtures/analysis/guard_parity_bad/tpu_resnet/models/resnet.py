@@ -1,0 +1,41 @@
+"""The PRE-FIX constructor code (ADVICE r4 regression fixture).
+
+This is the shape of models/resnet.py before the satellite fixes landed:
+``BlockLayer`` silently drops ``bn_axis_name`` when fused, and the public
+constructors carry none of ``build_model``'s guards — calling
+``cifar_resnet_v2(28, 100, width_multiplier=10, fused_blocks=True)``
+directly hits an obscure downstream tile error, and fused + sync-BN
+silently computes per-replica BN. Rule guard-parity must flag all four
+sites."""
+
+from typing import Optional
+
+
+class BlockLayer:
+    filters: int = 16
+    bottleneck: bool = False
+    bn_axis_name: Optional[str] = None
+    fused: bool = False
+
+    def __call__(self, x, *, train: bool):
+        # PRE-FIX: dispatches to the fused kernels without re-checking
+        # bn_axis_name — sync-BN callers silently get per-replica BN.
+        fuse = self.fused and not self.bottleneck
+        block_cls = "FusedBuildingBlock" if fuse else "BuildingBlock"
+        return block_cls, x, train
+
+
+def cifar_resnet_v2(resnet_size, num_classes, width_multiplier=1,
+                    bn_axis_name=None, fused_blocks=False):
+    # PRE-FIX: no _check_fused_bn_axis, no width_multiplier guard.
+    if resnet_size % 6 != 2:
+        raise ValueError("resnet_size must be 6n+2")
+    return ("ResNetV2", resnet_size, num_classes, width_multiplier,
+            bn_axis_name, fused_blocks)
+
+
+def imagenet_resnet_v2(resnet_size, num_classes, bn_axis_name=None,
+                       fused_blocks=False):
+    # PRE-FIX: no _check_fused_bn_axis.
+    return ("ResNetV2", resnet_size, num_classes, bn_axis_name,
+            fused_blocks)

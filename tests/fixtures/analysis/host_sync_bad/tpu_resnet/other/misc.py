@@ -1,0 +1,15 @@
+"""A @jax.jit function OUTSIDE the jit-scope modules: the rule must
+still find it via its decorator; the undecorated sibling is exempt."""
+
+import jax
+
+
+@jax.jit
+def jitted_probe(x):
+    print("inside jit")   # flagged: host I/O under an explicit jax.jit
+    return x * 2
+
+
+def host_side_logger(x):
+    print("host", x)      # NOT flagged: plain host function
+    return x

@@ -1,0 +1,30 @@
+"""Seeded jit-host-sync violations (fixture for tests/test_analysis.py).
+
+This file sits at the jit-scope path (tpu_resnet/train/step.py) of a
+fixture mini-tree: every hazard below must be flagged."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def make_train_step(model):
+    def train_step(state, images, labels):
+        print("step", state.step)                       # host I/O
+        t0 = time.time()                                # host clock
+        noise = np.random.default_rng(0).normal()       # trace-time RNG
+        jitter = random.random()                        # trace-time RNG
+        loss = (images.mean() + noise + jitter).item()  # device sync
+        host_labels = jax.device_get(labels)            # device sync
+        images.block_until_ready()                      # device sync
+        return state, {"loss": loss, "t": t0,
+                       "labels": host_labels}
+
+    return train_step
+
+
+def clean_helper(images):
+    # No hazards: must NOT be flagged.
+    return images.astype("float32") / 255.0

@@ -1,0 +1,30 @@
+"""Seeded signal-safety violations: a handler that does real work
+(checkpoint save, file I/O, lock, sleep) instead of setting a flag."""
+
+import signal
+import threading
+import time
+
+
+class EagerShutdown:
+    """The anti-pattern: 'just save right here in the handler'."""
+
+    def __init__(self, ckpt, train_dir):
+        self._ckpt = ckpt
+        self._train_dir = train_dir
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        self._event.set()               # fine
+        self._finalize(signum)          # transitively unsafe
+
+    def _finalize(self, signum):
+        self._lock.acquire()            # flagged: lock in handler path
+        self._ckpt.save(0, force=True)  # flagged: checkpoint save
+        with open(self._train_dir + "/stop", "w") as fh:  # flagged: open
+            fh.write(str(signum))
+        time.sleep(0.5)                 # flagged: sleep in handler

@@ -1,0 +1,48 @@
+"""Seeded jit-static-args violations (fixture for test_analysis.py)."""
+
+import jax
+
+
+@jax.jit
+def decorated_step(x, train: bool):         # flagged: traced bool param
+    return x if x.sum() > 0 else -x
+
+
+@jax.jit
+def defaulted_mode(x, mode="fast"):         # flagged: traced str default
+    return x
+
+
+@jax.jit
+def covered_ok(x, eps: float = 1e-5):       # NOT flagged: float traces fine
+    return x + eps
+
+
+def helper(x, train: bool):
+    return x
+
+
+helper_jitted = jax.jit(helper, static_argnums=(1,))   # NOT flagged: covered
+helper_named = jax.jit(helper, static_argnames=("train",))  # NOT flagged
+helper_bad = jax.jit(helper)                # flagged: bool param uncovered
+lambda_bad = jax.jit(lambda x, flag=True: x)  # flagged: bool default
+wrong_container = jax.jit(helper, static_argnums={1})  # flagged: unhashable
+wrong_kind = jax.jit(helper, static_argnums=("train",))  # flagged: str argnum
+
+IDX = 1
+symbolic_ok = jax.jit(helper, static_argnums=(0, IDX))  # NOT flagged:
+# symbolic element — coverage unknowable, legal jax; sub-check B skipped
+
+
+def posonly(x, /, train: bool):
+    return x
+
+
+posonly_ok = jax.jit(posonly, static_argnums=(1,))  # NOT flagged: index 1
+# counts posonlyargs + args together, exactly as jax does
+
+
+@jax.jit
+def kwonly_bad(x, *, train: bool = True):  # flagged: kw-only traced bool
+    return x
+

@@ -136,9 +136,17 @@ def test_run_rejects_oversized_chunk():
         run(state, 0, 3)
 
 
+@pytest.mark.slow
 def test_train_loop_resident_end_to_end(tmp_path):
     """train() on the resident path: runs to train_steps, honors the
-    checkpoint interval, and resumes."""
+    checkpoint interval, and resumes.
+
+    Slow tier per the PR1-3 budget precedent (~70s, the heaviest test in
+    the default tier): the resident chunk/dispatch logic keeps fast
+    coverage via test_chunked_equals_sequential_steps and
+    test_staged_stream_chunks_equal_per_step, and the resident compiled
+    program of the headline config is pinned per-config by the analysis
+    config matrix (tests/test_analysis.py::test_repo_is_clean)."""
     cfg = load_config("smoke")
     cfg.data.device_resident = "on"
     cfg.train.steps_per_call = 7

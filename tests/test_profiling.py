@@ -59,6 +59,10 @@ def test_trace_window_during_training(tmp_path):
                      recursive=True)
 
 
+@pytest.mark.slow  # 22s: in-process train_and_eval e2e whose CLI-level
+# sibling (test_cli.py::test_train_and_eval_cli) stays tier-1; joined
+# the slow tier to keep the default tier inside the 870s verify budget
+# (precedent: PR1-3 budget moves).
 def test_train_and_eval(tmp_path):
     """train_and_eval trains to completion and produces the sidecar's
     best-precision artifact for the final checkpoint."""

@@ -1,0 +1,37 @@
+"""Static-analysis suite — ``python -m tpu_resnet check``.
+
+Two engines over one Finding model (docs/CHECKS.md):
+
+``jaxlint``       AST lints for the repo's JAX/TPU contracts (host-sync
+                  hazards under jit, static-arg hygiene, fork-safe worker
+                  import closure, signal-handler safety, fail-loud guard
+                  parity). Pure ``ast`` — importing it never imports jax.
+``configmatrix``  abstract-eval verifier: traces the real train/eval
+                  steps for every supported config combination on an
+                  abstract mesh and checks dtype discipline, donation
+                  layout, sharding contracts and golden jaxpr hashes.
+
+Import note: keep this ``__init__`` lazy-free and jax-free so the
+lint-only CLI path stays sub-second.
+"""
+
+from tpu_resnet.analysis.findings import (
+    Finding,
+    apply_baseline,
+    apply_pragmas,
+    load_baseline,
+    render_report,
+    save_baseline,
+)
+from tpu_resnet.analysis.jaxlint import RULES, run_jaxlint
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "apply_baseline",
+    "apply_pragmas",
+    "load_baseline",
+    "render_report",
+    "run_jaxlint",
+    "save_baseline",
+]

@@ -1,0 +1,193 @@
+"""CLI for the static-analysis suite.
+
+    python -m tpu_resnet check                 # lints + config matrix
+    python -m tpu_resnet check --skip-matrix   # lints only (<1s, no jax)
+    python -m tpu_resnet check --update-golden # intentional regeneration
+    tpu-resnet-check                           # console-script alias
+
+Exit code 0 = clean (after pragmas + baseline), 1 = error findings (or a
+stale baseline entry — the baseline only ever shrinks), 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpu_resnet.analysis.findings import (apply_baseline, load_baseline,
+                                          render_report, save_baseline)
+from tpu_resnet.analysis.jaxlint import RULES, run_jaxlint
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _default_root() -> str:
+    import tpu_resnet
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        tpu_resnet.__file__)))
+
+
+def _default_files(root: str):
+    """File set for the default root. A source checkout (pyproject.toml
+    or .git beside the package) lints wholesale; an installed package's
+    parent is site-packages — walking/linting the entire environment
+    there would take minutes and flag code the user doesn't own, so the
+    scan is pinned to the tpu_resnet package itself (rel paths keep
+    their 'tpu_resnet/' prefix so path-scoped rules still apply)."""
+    from tpu_resnet.analysis.jaxlint import discover
+
+    if any(os.path.exists(os.path.join(root, m))
+           for m in ("pyproject.toml", ".git")):
+        return None  # full checkout: let the engine discover
+    pkg = os.path.join(root, "tpu_resnet")
+    return ["tpu_resnet/" + rel for rel in discover(pkg)]
+
+
+def _prepare_jax_env() -> None:
+    """The config matrix is defined over the CPU abstract trace with an
+    8-way virtual mesh. When jax is not yet imported, pin that
+    environment (a TPU/GPU backend would only skip the golden compare
+    and slow tracing down); once imported it's too late — the verifier
+    then degrades gracefully."""
+    if "jax" in sys.modules:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-resnet-check",
+        description="JAX/TPU-aware static analysis: AST lints + "
+                    "config-matrix abstract verifier (docs/CHECKS.md)")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: the checkout "
+                        "containing the tpu_resnet package)")
+    p.add_argument("--rules", default="",
+                   help=f"comma-separated lint rule subset of "
+                        f"{sorted(RULES)}")
+    p.add_argument("--skip-lint", action="store_true")
+    p.add_argument("--skip-matrix", action="store_true",
+                   help="lint only — never imports jax, runs <1s")
+    p.add_argument("--update-golden", action="store_true",
+                   help="rewrite analysis/golden_jaxprs.json from the "
+                        "current programs (intentional program changes; "
+                        "commit the diff and say why)")
+    p.add_argument("--golden", default=None,
+                   help="alternate golden_jaxprs.json path")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file of accepted findings "
+                        "(default: analysis/baseline.json)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into the baseline")
+    p.add_argument("--json", dest="json_out", default="",
+                   help="also write findings as JSON to this path "
+                        "('-' = stdout)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule_id, fn in sorted(RULES.items()):
+            doc = (fn.__doc__ or "").strip().splitlines()
+            print(f"{rule_id:18s} {doc[0] if doc else ''}")
+        print("config-matrix      abstract-eval structural checks "
+              "(configmatrix.py)")
+        print("golden-jaxpr-drift compiled-program drift vs "
+              "golden_jaxprs.json")
+        return 0
+
+    root = args.root or _default_root()
+    files = None if args.root else _default_files(root)
+    select = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    # Partial runs (--skip-*/--rules) see only a subset of findings:
+    # they can neither judge baseline entries stale nor rewrite the
+    # baseline wholesale without deleting the other engines' entries.
+    full_run = not (args.skip_lint or args.skip_matrix or select)
+
+    findings = []
+    checked = []
+    if not args.skip_lint:
+        findings += run_jaxlint(root, select=select, files=files)
+        checked.append("lint")
+    stats = {}
+    if not args.skip_matrix:
+        _prepare_jax_env()
+        from tpu_resnet.analysis import configmatrix
+
+        golden_path = args.golden or configmatrix.GOLDEN_PATH
+        matrix_findings, stats = configmatrix.verify_matrix(
+            update_golden=args.update_golden, golden_path=golden_path)
+        findings += matrix_findings
+        checked.append(
+            f"matrix: {stats['traced']} traced, "
+            f"{stats['must_raise']} must-raise, "
+            f"{stats['hash_checked']} hash-checked, "
+            f"{stats['lowered']} lowered")
+        if args.update_golden:
+            print(f"updated {len(stats['updated'])} golden entries in "
+                  f"{golden_path}")
+
+    if args.write_baseline:
+        # A partial run MERGES: entries owned by engines/rules that
+        # didn't run are preserved verbatim (overwriting from a
+        # --skip-matrix run would silently delete every accepted
+        # config-matrix entry and fail the next full run); entries of
+        # the rules that DID run are replaced by today's findings, so
+        # fixed ones still drop out.
+        keep = []
+        if not full_run:
+            matrix_rules = {"config-matrix", "golden-jaxpr-drift"}
+            lint_rules = (set(select) if select
+                          else set(RULES) | {"parse"})
+
+            def ran(rule: str) -> bool:
+                if rule in matrix_rules:
+                    return not args.skip_matrix
+                return not args.skip_lint and rule in lint_rules
+
+            keep = [e for e in load_baseline(args.baseline)
+                    if not ran(e.get("rule", ""))]
+        save_baseline(args.baseline, findings, keep_entries=keep)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}"
+              + (f" (+{len(keep)} preserved from engines that didn't "
+                 f"run)" if keep else ""))
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+    # Staleness is only decidable on a FULL run: with --skip-matrix /
+    # --skip-lint / --rules, a baselined finding of a non-selected
+    # engine simply wasn't generated — reporting it stale (and exiting
+    # 1) would instruct the user to delete a live entry.
+    if not full_run:
+        stale = []
+
+    report = render_report(new, suppressed=len(suppressed), stale=stale,
+                           checked=", ".join(checked))
+    print(report)
+    if args.json_out:
+        payload = json.dumps(
+            {"findings": [f.to_dict() for f in new],
+             "suppressed": [f.to_dict() for f in suppressed],
+             "stale_baseline": stale, "matrix": stats}, indent=1)
+        if args.json_out == "-":
+            print(payload)
+        else:
+            with open(args.json_out, "w") as fh:
+                fh.write(payload + "\n")
+
+    errors = [f for f in new if f.severity == "error"]
+    return 1 if errors or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""Finding model shared by both check engines (jaxlint + config matrix).
+
+A finding is one structured violation: rule id, file:line, severity,
+message. Everything downstream — the human text report, the JSON output,
+the ``# check: disable=<rule>`` pragma filter and the checked-in baseline
+file — operates on this one shape, so a new rule only has to emit
+findings and gets suppression/reporting for free.
+
+Suppression layers (both designed for incremental adoption, docs/CHECKS.md):
+
+- pragma: ``# check: disable=rule-a,rule-b`` on the flagged line silences
+  those rules for that line; ``# check: disable-file=rule-a`` anywhere in
+  a file silences the rule for the whole file. Pragmas live next to the
+  code they excuse, so review sees them.
+- baseline: a checked-in JSON list of finding fingerprints that are
+  accepted-for-now. Fingerprints hash (rule, path, message) — not the
+  line number — so unrelated edits above a baselined finding don't churn
+  the file. Stale entries (baselined findings that no longer fire) are
+  reported so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+_PRAGMA_LINE = re.compile(r"#\s*check:\s*disable=([\w\-,\s]+)")
+_PRAGMA_FILE = re.compile(r"#\s*check:\s*disable-file=([\w\-,\s]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str            # root-relative, '/'-separated
+    line: int            # 1-based; 0 = whole-file/whole-config finding
+    message: str
+    severity: str = "error"
+
+    def fingerprint(self) -> str:
+        """Line-insensitive identity used by the baseline file."""
+        key = f"{self.rule}:{self.path}:{self.message}"
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.severity}: {self.message} [{self.rule}]"
+
+
+def _parse_rules(csv: str) -> List[str]:
+    return [r.strip() for r in csv.split(",") if r.strip()]
+
+
+def pragma_sets(source: str) -> Tuple[Dict[int, set], set]:
+    """(line -> disabled rules, file-level disabled rules) for a source
+    file. Lines are 1-based to match ``ast`` node locations.
+
+    Only actual COMMENT tokens count: pragma-shaped text inside a
+    docstring or string literal (e.g. documentation that *mentions* the
+    pragma syntax) must not disable anything, so the scan tokenizes
+    instead of regexing raw lines."""
+    import io
+    import tokenize
+
+    per_line: Dict[int, set] = {}
+    whole_file: set = set()
+    try:
+        tokens = [(tok.start[0], tok.string) for tok in
+                  tokenize.generate_tokens(io.StringIO(source).readline)
+                  if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Unparseable file: no comments recoverable, nothing disabled
+        # (the engine reports the parse failure as its own finding).
+        return per_line, whole_file
+    for lineno, text in tokens:
+        m = _PRAGMA_FILE.search(text)
+        if m:
+            whole_file.update(_parse_rules(m.group(1)))
+            continue
+        m = _PRAGMA_LINE.search(text)
+        if m:
+            per_line.setdefault(lineno, set()).update(
+                _parse_rules(m.group(1)))
+    return per_line, whole_file
+
+
+def apply_pragmas(findings: Sequence[Finding],
+                  sources: Dict[str, str]) -> List[Finding]:
+    """Drop findings whose line (or file) carries a disable pragma for
+    their rule. ``sources`` maps root-relative path -> file text."""
+    cache: Dict[str, Tuple[Dict[int, set], set]] = {}
+    kept = []
+    for f in findings:
+        src = sources.get(f.path)
+        if src is None:
+            kept.append(f)
+            continue
+        if f.path not in cache:
+            cache[f.path] = pragma_sets(src)
+        per_line, whole_file = cache[f.path]
+        disabled = per_line.get(f.line, set()) | whole_file
+        if f.rule not in disabled and "all" not in disabled:
+            kept.append(f)
+    return kept
+
+
+# ------------------------------------------------------------------ baseline
+def load_baseline(path: str) -> List[dict]:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return []
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path} must be a JSON list")
+    return data
+
+
+def save_baseline(path: str, findings: Sequence[Finding],
+                  keep_entries: Iterable[dict] = ()) -> None:
+    """Write findings as the new baseline. ``keep_entries`` are existing
+    entries preserved verbatim (partial runs pass the entries of engines
+    that didn't run); deduped by fingerprint."""
+    entries = [{"fingerprint": f.fingerprint(), "rule": f.rule,
+                "path": f.path, "message": f.message}
+               for f in findings]
+    seen = {e["fingerprint"] for e in entries}
+    entries += [e for e in keep_entries
+                if e.get("fingerprint") not in seen]
+    entries.sort(key=lambda e: (e.get("path", ""), e.get("rule", ""),
+                                e.get("message", "")))
+    with open(path, "w") as fh:
+        json.dump(entries, fh, indent=1)
+        fh.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Iterable[dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split findings against a baseline.
+
+    Returns ``(new, suppressed, stale)``: findings not in the baseline,
+    findings silenced by it, and baseline entries that no longer fire
+    (candidates for deletion — the baseline only ever shrinks)."""
+    fps = {e.get("fingerprint") for e in baseline}
+    new = [f for f in findings if f.fingerprint() not in fps]
+    suppressed = [f for f in findings if f.fingerprint() in fps]
+    live = {f.fingerprint() for f in findings}
+    stale = [e for e in baseline if e.get("fingerprint") not in live]
+    return new, suppressed, stale
+
+
+# ------------------------------------------------------------------- report
+def render_report(findings: Sequence[Finding], *, suppressed: int = 0,
+                  stale: Sequence[dict] = (), checked: str = "") -> str:
+    lines = [f.format() for f in
+             sorted(findings, key=lambda f: (f.severity != "error",
+                                             f.path, f.line, f.rule))]
+    errors = sum(1 for f in findings if f.severity == "error")
+    warnings = len(findings) - errors
+    tail = (f"check: {errors} error(s), {warnings} warning(s)"
+            + (f", {suppressed} baselined" if suppressed else "")
+            + (f" [{checked}]" if checked else ""))
+    for e in stale:
+        lines.append(f"stale baseline entry (no longer fires, delete it): "
+                     f"{e.get('rule')} {e.get('path')} — {e.get('message')}")
+    lines.append(tail)
+    return "\n".join(lines)

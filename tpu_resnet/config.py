@@ -448,6 +448,13 @@ def _smoke() -> RunConfig:
     return cfg
 
 
+# The supported config space (these presets × mesh/dtype/fused/remat/
+# engine variations) is certified statically: tpu_resnet/analysis/
+# configmatrix.py traces the compiled train/eval program of every
+# combination in its MATRIX and pins it to a golden jaxpr hash, and the
+# unsupported combinations are must-raise entries there. Adding a field
+# here that changes the compiled step means adding/regenerating matrix
+# rows (`python -m tpu_resnet check --update-golden`; docs/CHECKS.md).
 PRESETS = {
     "cifar10": _cifar_local,
     "cifar100": _cifar100,

@@ -43,7 +43,10 @@ SHM_PREFIX = "tpures_ring_"
 # crash backstop (the engine's close() is the normal path and removes the
 # entry here).  Guarded by a lock: train loop closers and atexit can race.
 _created: set = set()
-_created_lock = threading.Lock()
+# Safe module-level lock: spawn re-runs this import, so every worker gets
+# its own fresh lock — nothing is shared or captured across the fork
+# boundary; it only serializes THIS process's closers against atexit.
+_created_lock = threading.Lock()  # check: disable=fork-safety
 
 
 def _atexit_unlink():

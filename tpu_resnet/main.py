@@ -26,6 +26,13 @@ def _setup_logging():
 
 def main(argv=None):
     _setup_logging()
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if raw[:1] == ["check"]:
+        # Delegated wholesale: the analysis CLI owns its flag surface
+        # (argparse.REMAINDER can't forward leading --flags), and this
+        # path must not import jax until it decides to.
+        from tpu_resnet.analysis.cli import main as check_main
+        return check_main(raw[1:])
     parser = argparse.ArgumentParser(prog="tpu_resnet")
     sub = parser.add_subparsers(dest="command", required=True)
     for name, help_text in [
@@ -40,9 +47,11 @@ def main(argv=None):
         ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
         ("doctor", "environment triage: backend probe, CPU mesh smoke, "
                    "native plane, dataset layout, run telemetry"),
+        ("check", "static analysis: JAX/TPU AST lints + config-matrix "
+                  "abstract verifier (docs/CHECKS.md)"),
     ]:
         p = sub.add_parser(name, help=help_text)
-        if name not in ("fetch", "doctor"):  # these take no run config
+        if name not in ("fetch", "doctor", "check"):  # no run config
             p.add_argument("--preset", default="")
             p.add_argument("--config", default="")
             p.add_argument("overrides", nargs="*")
@@ -80,6 +89,9 @@ def main(argv=None):
             p.add_argument("--out", required=True, help="dataset directory")
             p.add_argument("--keep-archive", action="store_true")
         if name == "doctor":
+            p.add_argument("--check", action="store_true",
+                           help="also run the static-analysis suite "
+                                "(lints + config-matrix verifier)")
             p.add_argument("--dataset", default="",
                            help="with --data-dir: layout to validate")
             p.add_argument("--data-dir", default="")
@@ -115,7 +127,8 @@ def main(argv=None):
                              probe_timeout=args.probe_timeout,
                              mesh_devices=args.mesh_devices,
                              fault_drill=args.fault_drill,
-                             data_bench=args.data_bench)
+                             data_bench=args.data_bench,
+                             check=args.check)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

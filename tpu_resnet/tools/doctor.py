@@ -30,6 +30,11 @@ Checks:
              can tell host-bound from chip-bound without a full bench
              run (the same probe backs bench.py's host_decode
              worker-scaling curve)
+  check      optional (--check): the static-analysis suite (tpu_resnet/
+             analysis): AST lints for the repo's JAX/TPU contracts plus
+             the config-matrix abstract verifier with golden jaxpr
+             hashes — `python -m tpu_resnet check` for operators who
+             want one doctor line instead of the full report
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -184,6 +189,62 @@ def _check_data_bench(seconds: float = 4.0) -> dict:
     return {"ok": ok, **probe}
 
 
+def _check_static_analysis(matrix: bool = True, timeout: int = 900) -> dict:
+    """Static-analysis suite (tpu_resnet/analysis) as one doctor line.
+
+    Runs ``python -m tpu_resnet check`` in a FRESH scrubbed-CPU
+    subprocess (same env discipline as the cpu_mesh and fault-drill
+    checks): the verifier's goldens are defined over the CPU abstract
+    trace with 8 virtual devices. In the doctor's own process jax is
+    already initialized on the ambient backend by the versions check,
+    and an ambient ``JAX_PLATFORMS=tpu``/plugin hook would also defeat
+    the check CLI's setdefault-based pin — the golden-hash and lowering
+    checks would silently be skipped (reporting ok while verifying much
+    less), or the child could hang on a wedged plugin. ``matrix=False``
+    is the fast lint-only form (used by tests; the full matrix re-traces
+    every supported config, ~1-2 min on CPU)."""
+    import tempfile
+
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    cmd = [sys.executable, "-m", "tpu_resnet", "check"]
+    if not matrix:
+        cmd.append("--skip-matrix")
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_check_") as d:
+        out_json = os.path.join(d, "findings.json")
+        try:
+            proc = subprocess.run(cmd + ["--json", out_json],
+                                  env=scrubbed_cpu_env(8),
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return {"ok": False, "error": f"check hung for {timeout}s"}
+        out = {"ok": proc.returncode == 0, "rc": proc.returncode}
+        try:
+            with open(out_json) as fh:
+                payload = json.load(fh)
+            errors = [f for f in payload["findings"]
+                      if f["severity"] == "error"]
+            out.update(errors=len(errors),
+                       warnings=len(payload["findings"]) - len(errors),
+                       baselined=len(payload["suppressed"]),
+                       stale_baseline=len(payload["stale_baseline"]))
+            if matrix:
+                out["matrix_traced"] = payload.get("matrix",
+                                                   {}).get("traced")
+                out["matrix_must_raise"] = payload.get(
+                    "matrix", {}).get("must_raise")
+            if errors:
+                e = errors[0]
+                out["first"] = (f"{e['path']}:{e['line']}: "
+                                f"{e['message']} [{e['rule']}]")
+        except (OSError, ValueError, KeyError):
+            out["ok"] = False
+            out["tail"] = proc.stdout.strip().splitlines()[-5:]
+        return out
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -226,7 +287,8 @@ def _check_fault_drill(timeout: int = 240) -> dict:
 def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                probe_timeout: int = 60, mesh_devices: int = 8,
                fault_drill: bool = False, data_bench: bool = False,
-               data_bench_secs: float = 4.0, stream=None) -> dict:
+               data_bench_secs: float = 4.0, check: bool = False,
+               check_matrix: bool = True, stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -253,6 +315,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if data_bench:
         summary["data_bench"] = _check_data_bench(seconds=data_bench_secs)
         emit("data_bench", summary["data_bench"])
+    if check:
+        summary["check"] = _check_static_analysis(matrix=check_matrix)
+        emit("check", summary["check"])
     if fault_drill:
         summary["fault_drill"] = _check_fault_drill()
         emit("fault_drill", summary["fault_drill"])
