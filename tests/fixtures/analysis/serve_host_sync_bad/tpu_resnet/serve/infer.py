@@ -1,0 +1,25 @@
+"""Seeded jit-host-sync violations in the serving hot path: host work
+inside the compiled inference fn runs per coalesced batch and multiplies
+into every request's latency (the real serve/infer.py is jit scope)."""
+
+import time
+
+import numpy as np
+
+
+def make_serve_infer(model):
+    def infer(variables, images):
+        t0 = time.perf_counter()          # flagged: host clock under jit
+        print("serving batch", images.shape)   # flagged: host I/O
+        noise = np.random.uniform(size=images.shape)  # flagged: host RNG
+        logits = model.apply(variables, images + noise, train=False)
+        logits.block_until_ready()        # flagged: device sync per call
+        _ = time.perf_counter() - t0
+        return logits
+
+    return infer
+
+
+def clean_helper(stats):
+    # Hazard-free function in the same jit-scope file: must stay silent.
+    return dict(stats)

@@ -1,0 +1,32 @@
+"""Seeded signal-safety violations for the serve SIGTERM path: a handler
+that drains the server inline instead of setting a flag for the main
+loop. The real serve/server.py delegates to resilience.ShutdownCoordinator
+(flag-only handler); this fixture is the anti-pattern that must stay
+flagged if anyone ever 'simplifies' the drain into the handler."""
+
+import signal
+import time
+
+
+class EagerDrainServer:
+    """'Just drain right here in the handler' — every call below runs at
+    an arbitrary bytecode boundary of the interrupted batcher loop."""
+
+    def __init__(self, batcher, httpd, registry):
+        self._batcher = batcher
+        self._httpd = httpd
+        self._registry = registry
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        self._registry.mark_unhealthy("draining")  # fine: sets a flag
+        self._drain_now(signum)                    # transitively unsafe
+
+    def _drain_now(self, signum):
+        self._batcher.drain(30.0)       # flagged: joins the worker thread
+        time.sleep(0.1)                 # flagged: sleep in handler
+        self._httpd.shutdown()          # flagged: socket teardown
+        with open("/tmp/drained", "w") as fh:  # flagged: file I/O
+            fh.write(str(signum))

@@ -158,6 +158,30 @@ def test_signal_safety_fixture():
     assert "_handle -> _finalize" in msgs
 
 
+def test_serve_host_sync_fixture():
+    """serve/infer.py is jit scope (the serving hot path): host clocks,
+    I/O, host RNG and per-call device syncs there are flagged."""
+    found = fixture_findings("serve_host_sync_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    for hazard in ("time.perf_counter", "print", "numpy.random",
+                   ".block_until_ready()"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    assert all(f.path == "tpu_resnet/serve/infer.py" for f in found)
+    assert not any("clean_helper" in f.message for f in found)
+
+
+def test_serve_signal_fixture():
+    """The serve SIGTERM anti-pattern (drain/teardown inline in the
+    handler instead of a flag) is in the signal-safety covered set."""
+    found = fixture_findings("serve_signal_bad", "signal-safety")
+    msgs = "\n".join(f.message for f in found)
+    for hazard in ("self._batcher.drain", "self._httpd.shutdown",
+                   "time.sleep", "'open'"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    # the transitive chain through the 'do it now' helper is reported
+    assert "_handle -> _drain_now" in msgs
+
+
 def test_guard_parity_fixture_flags_pre_fix_code():
     """The ADVICE r4 regression: the PRE-fix constructors (no
     _check_fused_bn_axis, no width guard) must all be flagged."""

@@ -280,11 +280,11 @@ class _FlakyCkpt:
 
 
 def test_eval_restore_retry_transient_then_success():
-    from tpu_resnet.evaluation.evaluator import _restore_with_retry
+    from tpu_resnet.train.checkpoint import restore_with_retry
 
     sleeps = []
     ckpt = _FlakyCkpt(2)
-    out = _restore_with_retry(ckpt, None, 7, retries=3, backoff_sec=0.5,
+    out = restore_with_retry(ckpt, None, 7, retries=3, backoff_sec=0.5,
                               sleep=sleeps.append)
     assert out == {"restored": 7}
     assert ckpt.calls == 3
@@ -292,10 +292,10 @@ def test_eval_restore_retry_transient_then_success():
 
 
 def test_eval_restore_retry_gives_up_returns_none():
-    from tpu_resnet.evaluation.evaluator import _restore_with_retry
+    from tpu_resnet.train.checkpoint import restore_with_retry
 
     sleeps = []
-    out = _restore_with_retry(_FlakyCkpt(99), None, 7, retries=3,
+    out = restore_with_retry(_FlakyCkpt(99), None, 7, retries=3,
                               backoff_sec=0.1, sleep=sleeps.append)
     assert out is None
     assert sleeps == [0.1, 0.2]  # no sleep after the final failure

@@ -1,0 +1,255 @@
+"""Load generator for the predict server (tpu_resnet/serve).
+
+Hammers ``POST /predict`` with concurrent clients and reports serving
+throughput + latency percentiles the same way ``bench.py`` reports
+training: one machine-parseable ``RESULT_JSON:`` line, emitted through
+bench's hardened single-write path (atomic on pipes, so a killed run
+leaves either a whole line or a truncated one the salvage parser skips —
+never a corrupt-but-parseable one).
+
+Two traffic models:
+
+``--mode closed`` (default)  N clients in a closed loop: each fires its
+    next request the moment the previous one returns. Measures capacity —
+    max sustainable throughput at concurrency N.
+``--mode open``  N clients paced to a global ``--qps`` arrival rate,
+    independent of response times (requests queue up when the server
+    falls behind). Measures latency under a fixed offered load — the
+    shape real user traffic has.
+
+After the run the server's ``/metrics`` is scraped so the report carries
+the *server-side* view too: observed mean batch size (the dynamic
+batcher's coalescing in action), pad fraction, rejected count.
+
+Usage:
+    python tools/loadgen.py --url http://127.0.0.1:PORT [--clients 8]
+        [--duration 10] [--mode closed|open] [--qps 100]
+        [--images-per-request 1] [--out result.json]
+    python tools/loadgen.py --train-dir /tmp/run   # port from serve.json
+
+Exit code 0 = ran with zero failed requests, 1 = any failure/rejection
+(``--allow-rejects`` downgrades 429s to a count — expected when probing
+the backpressure contract), 2 = could not reach the server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from bench import _print_line  # noqa: E402  (hardened single-write emit)
+from tpu_resnet.obs.server import parse_prometheus  # noqa: E402
+from tpu_resnet.serve.batcher import percentile  # noqa: E402
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _scrape_metrics(base: str) -> dict:
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            return parse_prometheus(r.read().decode())
+    except (OSError, ValueError):
+        return {}
+
+
+class ClientStats:
+    """Per-client tally merged at the end (no cross-thread locking in the
+    request path)."""
+
+    def __init__(self):
+        self.latencies_ms = []
+        self.ok = 0
+        self.rejected = 0   # 429 backpressure
+        self.failed = 0     # anything else
+        self.images = 0
+
+
+def _fire(url: str, body: bytes, shape: str, timeout: float) -> int:
+    req = urllib.request.Request(
+        url + "/predict", data=body,
+        headers={"Content-Type": "application/octet-stream",
+                 "X-Shape": shape})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            r.read()
+            return r.status
+    except urllib.error.HTTPError as e:
+        e.read()
+        return e.code
+    except OSError:
+        return -1
+
+
+def _client_loop(url: str, images: np.ndarray, deadline: float,
+                 stats: ClientStats, interval: float, start_at: float,
+                 timeout: float) -> None:
+    body = images.tobytes()
+    shape = ",".join(str(d) for d in images.shape)
+    n = images.shape[0]
+    next_at = start_at
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            return
+        if interval > 0:      # open loop: fixed arrival schedule
+            if next_at > now:
+                time.sleep(min(next_at - now, deadline - now))
+                if time.monotonic() >= deadline:
+                    return
+            next_at += interval
+        t0 = time.monotonic()
+        status = _fire(url, body, shape, timeout)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        if status == 200:
+            stats.ok += 1
+            stats.images += n
+            stats.latencies_ms.append(dt_ms)
+        elif status == 429:
+            stats.rejected += 1
+        else:
+            stats.failed += 1
+
+
+def run_load(url: str, clients: int = 8, duration: float = 10.0,
+             mode: str = "closed", qps: float = 100.0,
+             images_per_request: int = 1, image_size: int = 0,
+             timeout: float = 30.0, seed: int = 0) -> dict:
+    """Drive the server; returns the result dict (see RESULT_JSON)."""
+    url = url.rstrip("/")
+    info = _get_json(url + "/info")
+    h, w, c = info["image_shape"]
+    if image_size and image_size != h:
+        raise ValueError(f"--image-size {image_size} != server model "
+                         f"input {h}")
+    metrics_before = _scrape_metrics(url)
+    rng = np.random.RandomState(seed)
+    interval = clients / qps if mode == "open" else 0.0
+    t_start = time.monotonic()
+    deadline = t_start + duration
+    stats = [ClientStats() for _ in range(clients)]
+    threads = []
+    for i, st in enumerate(stats):
+        images = rng.randint(0, 255, (images_per_request, h, w, c)
+                             ).astype(np.uint8)
+        # Open loop: stagger client phases so the aggregate arrival
+        # process is uniform at ``qps``, not ``clients`` synchronized
+        # bursts.
+        start_at = t_start + (interval * i / clients if interval else 0.0)
+        t = threading.Thread(target=_client_loop,
+                             args=(url, images, deadline, st, interval,
+                                   start_at, timeout), daemon=True)
+        threads.append(t)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + timeout + 10)
+    wall = time.monotonic() - t_start
+
+    lat = sorted(x for st in stats for x in st.latencies_ms)
+    ok = sum(st.ok for st in stats)
+    rejected = sum(st.rejected for st in stats)
+    failed = sum(st.failed for st in stats)
+    images = sum(st.images for st in stats)
+    metrics = _scrape_metrics(url)
+    ns = "tpu_resnet_"
+    result = {
+        "mode": mode, "clients": clients, "duration_sec": round(wall, 2),
+        "images_per_request": images_per_request,
+        "offered_qps": qps if mode == "open" else None,
+        "requests_ok": ok, "rejected_429": rejected, "failed": failed,
+        "throughput_rps": round(ok / max(wall, 1e-9), 2),
+        "images_per_sec": round(images / max(wall, 1e-9), 2),
+        "latency_ms": {
+            "p50": round(percentile(lat, 0.50), 2),
+            "p95": round(percentile(lat, 0.95), 2),
+            "p99": round(percentile(lat, 0.99), 2),
+            "mean": round(float(np.mean(lat)), 2) if lat else 0.0,
+            "max": round(lat[-1], 2) if lat else 0.0,
+        },
+        "server": {
+            "model_step": info.get("model_step"),
+            "observed_mean_batch": round(
+                metrics.get(ns + "serve_batch_size_mean", 0.0), 3),
+            "pad_fraction": round(
+                metrics.get(ns + "serve_pad_fraction", 0.0), 4),
+            "reloads": int(metrics.get(ns + "serve_reloads_total", 0)),
+            "requests_total": int(
+                metrics.get(ns + "serve_requests_total", 0)
+                - metrics_before.get(ns + "serve_requests_total", 0)),
+        },
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="",
+                    help="server base url (http://host:port)")
+    ap.add_argument("--train-dir", default="",
+                    help="discover the port from <train-dir>/serve.json")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="open-loop aggregate arrival rate")
+    ap.add_argument("--images-per-request", type=int, default=1)
+    ap.add_argument("--image-size", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--allow-rejects", action="store_true",
+                    help="429s don't fail the run (backpressure probes)")
+    ap.add_argument("--out", default="",
+                    help="also write the result json to this path "
+                         "(atomic tmp+rename)")
+    args = ap.parse_args(argv)
+
+    url = args.url
+    if not url:
+        if not args.train_dir:
+            ap.error("need --url or --train-dir")
+        from tpu_resnet.serve.server import read_serve_port
+        port = read_serve_port(args.train_dir)
+        if port is None:
+            print(f"[loadgen] no serve.json under {args.train_dir}",
+                  file=sys.stderr)
+            return 2
+        url = f"http://127.0.0.1:{port}"
+
+    try:
+        result = run_load(url, clients=args.clients,
+                          duration=args.duration, mode=args.mode,
+                          qps=args.qps,
+                          images_per_request=args.images_per_request,
+                          image_size=args.image_size,
+                          timeout=args.timeout, seed=args.seed)
+    except (OSError, ValueError) as e:
+        print(f"[loadgen] cannot drive {url}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.out:
+        tmp = args.out + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(result, f, indent=2)
+        os.replace(tmp, args.out)
+    _print_line("RESULT_JSON: " + json.dumps(result))
+    bad = result["failed"] + (0 if args.allow_rejects
+                              else result["rejected_429"])
+    return 0 if bad == 0 and result["requests_ok"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,7 +4,8 @@ Each rule encodes a contract this codebase already relies on but (before
 this subsystem) only enforced dynamically, if at all:
 
 jit-host-sync     No side effects or host syncs in jit-reachable code
-                  (``train/step.py``, ``ops/*`` and any ``@jax.jit``
+                  (``train/step.py``, ``serve/infer.py`` — the serving
+                  hot path — ``ops/*`` and any ``@jax.jit``
                   function anywhere): ``print``, ``time.*`` clocks,
                   ``np.random``/``random`` (host RNG under trace runs
                   ONCE and bakes a constant into the program),
@@ -52,7 +53,12 @@ EXCLUDE_DIRS = {"tests", "docs", "launch", "__pycache__", ".git",
                 ".jax_cache", "build", "dist"}
 
 # jit-reachable modules linted wholesale (every function body).
-JIT_SCOPE_FILES = ("tpu_resnet/train/step.py",)
+# serve/infer.py is the serving hot path: its compiled inference fn runs
+# per coalesced batch, so a host sync there multiplies into every
+# request's latency (host-side serving code lives in serve/batcher.py
+# and serve/server.py, which are NOT jit scope).
+JIT_SCOPE_FILES = ("tpu_resnet/train/step.py",
+                   "tpu_resnet/serve/infer.py")
 JIT_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 
 # Module-scope import closure of the spawn'd decode worker
@@ -83,8 +89,12 @@ HOST_SYNC_METHODS = {
 SIGNAL_DENY_PREFIXES = ("subprocess.", "jax.", "jax_", "numpy.",
                         "shutil.", "socket.", "os.system", "os.popen")
 SIGNAL_DENY_EXACT = {"open", "time.sleep", "exec", "eval"}
+# "drain"/"shutdown": the serve SIGTERM anti-pattern — draining the
+# micro-batcher or tearing down the HTTP socket inline in the handler
+# instead of setting a flag for the serve() loop (serve/server.py).
 SIGNAL_DENY_METHODS = {"save", "restore", "acquire", "join", "wait",
-                       "sleep", "write", "flush", "dump"}
+                       "sleep", "write", "flush", "dump", "drain",
+                       "shutdown"}
 SIGNAL_LOG_ROOTS = {"log", "logger", "logging"}
 
 # (file, qualname, requirement) — requirement is "calls:<fn>" (body must

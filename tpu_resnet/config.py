@@ -319,6 +319,53 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass
+class ServeConfig:
+    """Online inference server (tpu_resnet/serve; docs/SERVING.md).
+
+    The serving shape the training side never needed: requests arrive one
+    at a time, the hardware wants batches — the dynamic micro-batcher
+    coalesces the request queue into a small set of bucketed batch shapes
+    compiled ahead of time at startup, so no client mix ever triggers a
+    mid-traffic recompile."""
+
+    # HTTP port: 0 = OS-assigned ephemeral (recorded in
+    # <train_dir>/serve.json like the telemetry discovery file), >0 fixed.
+    port: int = 0
+    host: str = "0.0.0.0"
+    # "checkpoint": serve live weights from train.train_dir with
+    # hot-reload (poll for new steps, atomic swap between batches).
+    # "export": serve a frozen StableHLO bundle from ``export_dir``
+    # (weights baked in — no reload; the .pb-serving analog).
+    backend: str = "checkpoint"  # checkpoint | export
+    export_dir: str = ""
+    # Micro-batcher: coalesce queued requests until ``max_batch`` images
+    # or ``max_wait_ms`` since the oldest queued request, whichever first.
+    # max_wait_ms bounds the latency cost of batching for a lone request.
+    max_batch: int = 16
+    max_wait_ms: float = 5.0
+    # Batch shapes compiled at startup. () = auto: powers of two up to
+    # max_batch (1,2,4,...). Every batch pads up to the smallest bucket
+    # that fits (pad fraction is exported as a gauge); requests larger
+    # than max_batch are split across batches.
+    batch_buckets: tuple = ()
+    # Admission control: max requests queued ahead of the batcher. A full
+    # queue rejects with HTTP 429 (backpressure) instead of letting the
+    # tail latency grow without bound; a draining server rejects with 503.
+    max_queue: int = 256
+    # Hot-reload poll interval (checkpoint backend; 0 disables reload).
+    # Restore retries/backoff reuse resilience.eval_restore_* — the same
+    # mid-commit-checkpoint hazard the eval sidecar has.
+    reload_interval_secs: float = 10.0
+    # SIGTERM drain: stop accepting, flush the queue, then exit 0. After
+    # this many seconds still-queued requests fail with 503 and the
+    # server exits anyway (a second signal aborts immediately).
+    drain_timeout_secs: float = 30.0
+    # Latency ring: recent per-request latencies kept for the p50/p95/p99
+    # gauges on /metrics.
+    latency_ring: int = 1024
+
+
+@dataclasses.dataclass
 class RunConfig:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -327,6 +374,7 @@ class RunConfig:
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
     # ---------------------------------------------------------- serialization
     def to_dict(self) -> dict:

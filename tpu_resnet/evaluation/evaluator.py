@@ -30,7 +30,8 @@ from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
-from tpu_resnet.train.checkpoint import CheckpointManager, latest_step_in
+from tpu_resnet.train.checkpoint import (CheckpointManager, latest_step_in,
+                                         restore_with_retry)
 from tpu_resnet.train.metrics_io import MetricsWriter
 from tpu_resnet.train.state import init_state
 from tpu_resnet.train.step import make_eval_step
@@ -118,28 +119,9 @@ def _template_state(cfg: RunConfig, model, mesh):
     return jax.device_put(state, parallel.replicated(mesh))
 
 
-def _restore_with_retry(ckpt, template, step: int, retries: int = 3,
-                        backoff_sec: float = 0.5, sleep=time.sleep):
-    """Restore ``step`` with bounded exponential-backoff retries.
-
-    The trainer's saves are async: the evaluator's poll can see a step
-    whose directory is still mid-commit, and a single transient restore
-    failure used to kill the whole sidecar loop. Returns the state, or
-    None after ``retries`` failures (the caller skips-and-logs the step
-    instead of crashing — the next checkpoint will be evaluated fine)."""
-    for attempt in range(max(1, retries)):
-        try:
-            return ckpt.restore(template, step=step)
-        except Exception as e:  # noqa: BLE001 - any restore failure
-            wait = backoff_sec * (2 ** attempt)
-            log.warning("restore of checkpoint step %d failed "
-                        "(attempt %d/%d, %s: %s)%s", step, attempt + 1,
-                        max(1, retries), type(e).__name__, e,
-                        f"; retrying in {wait:.1f}s"
-                        if attempt + 1 < max(1, retries) else "")
-            if attempt + 1 < max(1, retries):
-                sleep(wait)
-    return None
+# Back-compat alias: the restore-retry logic moved to
+# train/checkpoint.py so the serve hot-reload path shares it verbatim.
+_restore_with_retry = restore_with_retry
 
 
 def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
@@ -191,7 +173,7 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
                     break
                 continue
             if step != last_seen:
-                state = _restore_with_retry(
+                state = restore_with_retry(
                     ckpt, template, step,
                     retries=cfg.resilience.eval_restore_retries,
                     backoff_sec=cfg.resilience.eval_restore_backoff_sec)

@@ -54,11 +54,14 @@ def make_inference_fn(cfg: RunConfig, params, batch_stats) -> Callable:
 
 
 def save_inference(cfg: RunConfig, params, batch_stats, out_dir: str,
-                   batch_size: int = 0) -> str:
+                   batch_size: int = 0, step: int | None = None) -> str:
     """Freeze params into a serialized StableHLO artifact.
 
     ``batch_size=0`` exports with a symbolic (polymorphic) batch dimension;
-    a fixed size pins it like the reference's placeholder shape.
+    a fixed size pins it like the reference's placeholder shape. ``step``
+    (when known — ``export_from_checkpoint`` passes the restored step)
+    is recorded in the manifest so serving a frozen bundle can still
+    report which training step it is (the ``serve_model_step`` gauge).
     """
     os.makedirs(out_dir, exist_ok=True)
     infer = make_inference_fn(cfg, params, batch_stats)
@@ -82,6 +85,7 @@ def save_inference(cfg: RunConfig, params, batch_stats, out_dir: str,
             "batch_size": batch_size or "dynamic",
             "input": "uint8 NHWC, raw pixels (preprocessing baked in)",
             "output": "float32 logits",
+            "step": step if step is not None else -1,
         }, f, indent=2)
     return out_dir
 
@@ -129,4 +133,5 @@ def export_from_checkpoint(cfg: RunConfig, out_dir: str,
     state = ckpt.restore(template, step=step)
     return save_inference(cfg, jax.device_get(state.params),
                           jax.device_get(state.batch_stats), out_dir,
-                          batch_size=batch_size)
+                          batch_size=batch_size,
+                          step=int(jax.device_get(state.step)))

@@ -42,6 +42,8 @@ def main(argv=None):
         ("info", "print resolved config, param count and per-step FLOPs"),
         ("export", "freeze a checkpoint into a serialized inference artifact"),
         ("predict", "run a frozen artifact over the eval split"),
+        ("serve", "online inference: dynamic-batching HTTP predict server "
+                  "with checkpoint hot-reload (docs/SERVING.md)"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
@@ -105,6 +107,12 @@ def main(argv=None):
                                 "a temp train_dir (~30s tiny CPU run): "
                                 "preemption exit code, final checkpoint, "
                                 "exact-step resume")
+            p.add_argument("--serve-probe", action="store_true",
+                           help="live predict-server smoke (~60s tiny CPU "
+                                "run): train a small model, serve it on "
+                                "an ephemeral port, fire requests, check "
+                                "/healthz readiness and the SIGTERM "
+                                "drain exit-code contract")
             p.add_argument("--data-bench", action="store_true",
                            help="~20s synthetic-JPEG decode throughput "
                                 "probe: images/sec at 1 vs N decode "
@@ -128,7 +136,8 @@ def main(argv=None):
                              mesh_devices=args.mesh_devices,
                              fault_drill=args.fault_drill,
                              data_bench=args.data_bench,
-                             check=args.check)
+                             check=args.check,
+                             serve_probe=args.serve_probe)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config
@@ -190,6 +199,12 @@ def main(argv=None):
                             num_examples=args.num_examples,
                             label_file=args.label_file)
         return 0
+
+    if args.command == "serve":
+        from tpu_resnet import parallel
+        from tpu_resnet.serve import serve as serve_fn
+        parallel.initialize()
+        return serve_fn(cfg)
 
     if args.command == "inspect":
         from tpu_resnet.tools.inspect_ckpt import main as inspect_main

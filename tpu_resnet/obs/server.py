@@ -66,6 +66,30 @@ CORE_GAUGES = (
     ("fault_preemptions", "Graceful preemption stops (SIGTERM/SIGINT)"),
 )
 
+# Serving-process gauge set (tpu_resnet/serve; docs/SERVING.md). The
+# predict server reuses this registry/HTTP stack on its own port —
+# /healthz doubles as the readiness probe (unhealthy until the model is
+# loaded and every bucket shape is compiled; 503 again while draining).
+SERVE_GAUGES = (
+    ("serve_requests_total", "Predict requests admitted"),
+    ("serve_requests_rejected", "Requests rejected by admission control "
+                                "(bounded queue full -> HTTP 429)"),
+    ("serve_requests_failed", "Requests that failed during inference"),
+    ("serve_images_total", "Images admitted across all requests"),
+    ("serve_batches_total", "Coalesced batches dispatched to the model"),
+    ("serve_queue_depth", "Requests currently queued for batching"),
+    ("serve_batch_size_last", "Images in the most recent batch"),
+    ("serve_batch_size_mean", "Mean images per batch since start"),
+    ("serve_pad_fraction", "Padded fraction of all bucket slots "
+                           "dispatched (compile-avoidance cost)"),
+    ("serve_latency_p50_ms", "p50 request latency over the recent ring"),
+    ("serve_latency_p95_ms", "p95 request latency over the recent ring"),
+    ("serve_latency_p99_ms", "p99 request latency over the recent ring"),
+    ("serve_model_step", "Checkpoint step being served (-1 = frozen "
+                         "export bundle)"),
+    ("serve_reloads_total", "Checkpoint hot-reloads completed"),
+)
+
 
 def _sanitize(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_]", "_", name)
@@ -75,7 +99,11 @@ class TelemetryRegistry:
     """Thread-safe gauge store shared by the training loop (writer) and
     the HTTP server threads (readers)."""
 
-    def __init__(self, stale_after_sec: float = 300.0):
+    def __init__(self, stale_after_sec: float = 300.0, gauges=CORE_GAUGES):
+        """``gauges`` is the pre-declared series set — CORE_GAUGES for a
+        training process, SERVE_GAUGES for the predict server (scrapes
+        taken before the first batch must see explicit zeros, not absent
+        series)."""
         self.stale_after_sec = float(stale_after_sec)
         self._lock = threading.Lock()
         self._gauges: Dict[str, float] = {}
@@ -84,7 +112,7 @@ class TelemetryRegistry:
         self._hb_step: Optional[int] = None
         self._unhealthy_reason: Optional[str] = None
         self._started = time.time()
-        for name, help_text in CORE_GAUGES:
+        for name, help_text in gauges:
             self.set(name, 0.0, help=help_text)
 
     def set(self, name: str, value, help: str = "") -> None:

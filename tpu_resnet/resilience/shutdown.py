@@ -56,8 +56,16 @@ class ShutdownCoordinator:
 
     SIGNALS = (signal.SIGTERM, signal.SIGINT)
 
-    def __init__(self, enabled: bool = True):
+    def __init__(self, enabled: bool = True,
+                 action_desc: Optional[str] = None):
+        """``action_desc`` is what the first-signal log line promises the
+        process will now do — the trainer's default below; the predict
+        server passes its drain contract (stop accepting, flush the
+        queue, exit 0) so operators aren't told to expect exit 42."""
         self.enabled = enabled
+        self.action_desc = action_desc or (
+            f"finishing the current chunk, saving a final checkpoint, "
+            f"then exiting with code {PREEMPT_EXIT_CODE}")
         self.signum: Optional[int] = None
         self.requested_at: Optional[float] = None
         self._event = threading.Event()
@@ -88,10 +96,8 @@ class ShutdownCoordinator:
             raise KeyboardInterrupt(
                 f"second {signal.Signals(signum).name} during graceful "
                 f"shutdown — aborting immediately")
-        log.warning("received %s: finishing the current chunk, saving a "
-                    "final checkpoint, then exiting with code %d "
-                    "(send again to abort immediately)",
-                    signal.Signals(signum).name, PREEMPT_EXIT_CODE)
+        log.warning("received %s: %s (send again to abort immediately)",
+                    signal.Signals(signum).name, self.action_desc)
         self.request_stop(signum)
 
     def install(self) -> "ShutdownCoordinator":

@@ -1,0 +1,51 @@
+"""Online inference serving (docs/SERVING.md).
+
+``batcher``  dynamic micro-batcher: queue → coalesce → bucketed pad →
+             infer; bounded-queue admission control; drain. Pure host
+             code (numpy + stdlib, no jax, no sockets).
+``infer``    the jit-scope compiled inference fn (weights as arguments
+             so hot-reload never recompiles).
+``backend``  weight backends: frozen StableHLO bundle, or live
+             checkpoint dir with poll + atomic hot-reload.
+``server``   HTTP front end + /metrics + /healthz readiness + SIGTERM
+             drain; the ``tpu_resnet serve`` CLI entry.
+
+Lazy re-exports (PEP 562) keep ``import tpu_resnet.serve`` jax-free so
+stdlib-only consumers (loadgen, the doctor probe) can import the
+batcher/protocol helpers without a backend.
+"""
+
+__all__ = [
+    "Draining",
+    "MicroBatcher",
+    "PredictServer",
+    "QueueFull",
+    "build_backend",
+    "default_buckets",
+    "parse_predict_body",
+    "read_serve_port",
+    "serve",
+]
+
+_LAZY = {
+    "Draining": "tpu_resnet.serve.batcher",
+    "MicroBatcher": "tpu_resnet.serve.batcher",
+    "QueueFull": "tpu_resnet.serve.batcher",
+    "default_buckets": "tpu_resnet.serve.batcher",
+    "PredictServer": "tpu_resnet.serve.server",
+    "parse_predict_body": "tpu_resnet.serve.server",
+    "read_serve_port": "tpu_resnet.serve.server",
+    "serve": "tpu_resnet.serve.server",
+    "build_backend": "tpu_resnet.serve.backend",
+}
+
+
+def __getattr__(name):
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value
+    return value

@@ -1,0 +1,381 @@
+"""Online inference HTTP server — ``python -m tpu_resnet serve``.
+
+The reference's end state was a frozen ``.pb`` fed through a feed-dict
+predict *process* (resnet_cifar_predict_from_pd.py:66-105) — batch jobs,
+not a service. This module is the serving shape TPU systems treat as a
+first-class peer of training: an HTTP front end (the same stdlib
+``http.server`` threading pattern as ``obs/server.py``) over the dynamic
+micro-batcher (``batcher.py``) and a weight backend (``backend.py``),
+with the run-operations contracts this repo already standardized:
+
+- **telemetry**: ``/metrics`` + ``/healthz`` on the same port, reusing
+  ``obs.TelemetryRegistry`` with the ``SERVE_GAUGES`` series set;
+  ``/healthz`` is the readiness probe — 503 until the model is loaded and
+  every bucket shape compiled, 503 again while draining;
+- **backpressure**: bounded queue → HTTP 429, draining → 503; latency is
+  bounded by admission, not by hope;
+- **graceful drain**: SIGTERM via the existing
+  ``resilience.ShutdownCoordinator`` (flag-only handler — the PR-4
+  signal-safety lint covers this file): stop accepting, flush the queue,
+  exit 0.
+
+Wire protocol (``POST /predict``):
+
+- ``application/octet-stream``: raw uint8 pixels, shape in the
+  ``X-Shape: N,H,W,C`` header (N may be omitted and inferred from the
+  body length) — the fast path ``tools/loadgen.py`` uses;
+- ``application/json``: ``{"instances": [[...]]}`` nested uint8 lists,
+  one image ``[H,W,C]`` or a batch ``[N,H,W,C]``.
+
+Response: ``{"predictions": [...], "model_step": s, "count": n}``
+(plus ``"logits"`` with ``?logits=1``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpu_resnet.config import RunConfig
+from tpu_resnet.obs.server import SERVE_GAUGES, TelemetryRegistry
+from tpu_resnet.serve.batcher import (Draining, MicroBatcher, QueueFull,
+                                      default_buckets)
+
+log = logging.getLogger("tpu_resnet")
+
+# Upper bound a handler thread waits for its batched result; queued work
+# survives a drain, so this only fires if the batcher thread died.
+REQUEST_WAIT_SEC = 120.0
+SERVE_DISCOVERY = "serve.json"
+
+
+def parse_predict_body(body: bytes, content_type: str,
+                       shape_header: Optional[str],
+                       image_shape: Tuple[int, int, int]) -> np.ndarray:
+    """Request body → uint8 [N,H,W,C]. Raises ValueError on anything that
+    should be an HTTP 400."""
+    h, w, c = image_shape
+    if content_type.startswith("application/octet-stream"):
+        item = h * w * c
+        if shape_header:
+            try:
+                dims = tuple(int(x) for x in shape_header.split(","))
+            except ValueError:
+                raise ValueError(f"bad X-Shape header {shape_header!r}")
+            if len(dims) == 3:
+                dims = (len(body) // item,) + dims
+            if len(dims) != 4 or dims[1:] != image_shape:
+                raise ValueError(f"X-Shape {dims} does not match model "
+                                 f"input [N,{h},{w},{c}]")
+            n = dims[0]
+        else:
+            n = len(body) // item
+        if n < 1 or len(body) != n * item:
+            raise ValueError(f"body of {len(body)} bytes is not a whole "
+                             f"number of {h}x{w}x{c} uint8 images")
+        return np.frombuffer(body, np.uint8).reshape(n, h, w, c)
+    if content_type.startswith("application/json"):
+        try:
+            payload = json.loads(body.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ValueError(f"bad JSON body: {e}")
+        if not isinstance(payload, dict) or "instances" not in payload:
+            raise ValueError('JSON body must be {"instances": [...]}')
+        try:
+            arr = np.asarray(payload["instances"], np.uint8)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"instances not uint8-coercible: {e}")
+        if arr.ndim == 3:
+            arr = arr[None]
+        if arr.ndim != 4 or arr.shape[1:] != image_shape:
+            raise ValueError(f"instances shape {arr.shape} does not match "
+                             f"model input [N,{h},{w},{c}]")
+        return arr
+    raise ValueError(f"unsupported Content-Type {content_type!r} (use "
+                     f"application/octet-stream or application/json)")
+
+
+class PredictServer:
+    """Backend + micro-batcher + HTTP front end, drivable in-process
+    (tests) or via :func:`serve` (CLI)."""
+
+    def __init__(self, cfg: RunConfig, backend=None,
+                 registry: Optional[TelemetryRegistry] = None):
+        from tpu_resnet.serve.backend import build_backend
+
+        self.cfg = cfg
+        self.backend = backend if backend is not None \
+            else build_backend(cfg)
+        raw = cfg.serve.batch_buckets or default_buckets(
+            cfg.serve.max_batch)
+        self.buckets = self.backend.constrain_buckets(
+            tuple(sorted({int(b) for b in raw})))
+        self.image_shape = (self.backend.image_size,
+                            self.backend.image_size, 3)
+        self.registry = registry if registry is not None \
+            else TelemetryRegistry(
+                stale_after_sec=cfg.train.telemetry_stale_sec,
+                gauges=SERVE_GAUGES)
+        self.registry.mark_unhealthy(
+            "loading: compiling bucketed batch shapes")
+        self._reload_every = float(cfg.serve.reload_interval_secs)
+        self._next_reload = time.monotonic() + self._reload_every
+        self.batcher = MicroBatcher(
+            self.backend.infer, self.image_shape,
+            max_batch=max(self.buckets), max_wait_ms=cfg.serve.max_wait_ms,
+            buckets=self.buckets, max_queue=cfg.serve.max_queue,
+            between_batches=self._between_batches,
+            on_stats=self._publish_stats,
+            latency_ring=cfg.serve.latency_ring)
+        self._httpd = ThreadingHTTPServer((cfg.serve.host, cfg.serve.port),
+                                          self._make_handler())
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tpu-resnet-serve-http",
+            daemon=True)
+        self._closed = False
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> "PredictServer":
+        """Warm every bucket (compile ahead of traffic), then go ready.
+        The HTTP socket is already bound — probes hitting /healthz during
+        warmup see an honest 503, not a connection refused."""
+        self._http_thread.start()
+        t0 = time.monotonic()
+        self.backend.warmup(self.buckets)
+        log.info("serve: warmed %d bucket shapes %s in %.1fs",
+                 len(self.buckets), list(self.buckets),
+                 time.monotonic() - t0)
+        self.batcher.start()
+        self.registry.heartbeat(max(0, self.backend.model_step))
+        self._publish_stats(self.batcher.stats())
+        self.registry.clear_unhealthy()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop accepting, flush the queue, stop the batcher. The HTTP
+        server keeps answering (healthz reports draining) until
+        :meth:`close`."""
+        self.registry.mark_unhealthy("draining")
+        return self.batcher.drain(
+            self.cfg.serve.drain_timeout_secs if timeout is None
+            else timeout)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        close = getattr(self.backend, "close", None)
+        if close is not None:
+            close()
+
+    # ---------------------------------------------------------- batch hooks
+    def _between_batches(self) -> None:
+        """Runs on the batcher thread strictly between inferences: the
+        liveness heartbeat, and the rate-limited hot-reload poll — so a
+        weight swap can never interleave with an in-flight batch."""
+        self.registry.heartbeat(max(0, self.backend.model_step))
+        if self._reload_every <= 0:
+            return
+        now = time.monotonic()
+        if now < self._next_reload:
+            return
+        self._next_reload = now + self._reload_every
+        if self.backend.maybe_reload():
+            self.registry.set("serve_model_step", self.backend.model_step)
+            self.registry.set("serve_reloads_total", self.backend.reloads)
+
+    def _publish_stats(self, stats: dict) -> None:
+        self.registry.update({
+            "serve_requests_total": stats["requests"],
+            "serve_requests_rejected": stats["rejected"],
+            "serve_requests_failed": stats["failed"],
+            "serve_images_total": stats["images"],
+            "serve_batches_total": stats["batches"],
+            "serve_queue_depth": stats["queue_depth"],
+            "serve_batch_size_last": stats["batch_size_last"],
+            "serve_batch_size_mean": stats["batch_size_mean"],
+            "serve_pad_fraction": stats["pad_fraction"],
+            "serve_latency_p50_ms": stats["latency_p50_ms"],
+            "serve_latency_p95_ms": stats["latency_p95_ms"],
+            "serve_latency_p99_ms": stats["latency_p99_ms"],
+            "serve_model_step": self.backend.model_step,
+            "serve_reloads_total": self.backend.reloads,
+        })
+
+    # ---------------------------------------------------------- predict
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Submit ``images`` through the batcher (splitting requests
+        larger than the biggest bucket) and block for the logits. The
+        chunks are admitted atomically — a request that doesn't fully
+        fit is rejected before any of its inference runs."""
+        max_b = self.batcher.max_batch
+        pending = self.batcher.submit_many(
+            [images[i:i + max_b]
+             for i in range(0, images.shape[0], max_b)])
+        return np.concatenate([p.wait(REQUEST_WAIT_SEC) for p in pending])
+
+    def handle_predict(self, body: bytes, content_type: str,
+                       shape_header: Optional[str],
+                       want_logits: bool) -> Tuple[int, dict]:
+        """(status, response-json) for one predict call — pure enough to
+        unit test without sockets."""
+        try:
+            images = parse_predict_body(body, content_type, shape_header,
+                                        self.image_shape)
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        try:
+            logits = self.predict(images)
+        except QueueFull as e:
+            return 429, {"error": str(e), "retryable": True}
+        except Draining as e:
+            return 503, {"error": str(e)}
+        except TimeoutError as e:
+            return 504, {"error": str(e)}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except Exception as e:  # noqa: BLE001 - backend failure
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        out = {"predictions": np.argmax(logits, axis=-1).tolist(),
+               "model_step": int(self.backend.model_step),
+               "count": int(images.shape[0])}
+        if want_logits:
+            out["logits"] = np.asarray(logits, np.float64).tolist()
+        return 200, out
+
+    def info(self) -> dict:
+        return {
+            "backend": type(self.backend).__name__,
+            "model_step": int(self.backend.model_step),
+            "reloads": int(self.backend.reloads),
+            "image_shape": list(self.image_shape),
+            "num_classes": int(self.backend.num_classes),
+            "buckets": list(self.buckets),
+            "max_wait_ms": self.cfg.serve.max_wait_ms,
+            "max_queue": self.cfg.serve.max_queue,
+            "stats": self.batcher.stats(),
+        }
+
+    # ---------------------------------------------------------- HTTP layer
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _send(self, code: int, payload: dict,
+                      ctype: str = "application/json"):
+                body = json.dumps(payload).encode() \
+                    if not isinstance(payload, bytes) else payload
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200, server.registry.render().encode(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/healthz":
+                    health = server.registry.health()
+                    self._send(200 if health["ok"] else 503, health)
+                elif path in ("/", "/info"):
+                    self._send(200, server.info())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                path, _, query = self.path.partition("?")
+                if path != "/predict":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = 0
+                if length <= 0:
+                    self._send(400, {"error": "empty body"})
+                    return
+                body = self.rfile.read(length)
+                code, payload = server.handle_predict(
+                    body, self.headers.get("Content-Type", ""),
+                    self.headers.get("X-Shape"),
+                    want_logits="logits=1" in query)
+                self._send(code, payload)
+
+            def log_message(self, *args):  # request logs would swamp stderr
+                pass
+
+        return Handler
+
+
+def write_discovery(train_dir: str, port: int) -> None:
+    """Atomic ``<train_dir>/serve.json`` — the telemetry.json analog for
+    the predict server (loadgen/doctor dial the port from here)."""
+    os.makedirs(train_dir, exist_ok=True)
+    record = {"port": port, "pid": os.getpid(),
+              "hostname": socket.gethostname(), "started_at": time.time()}
+    path = os.path.join(train_dir, SERVE_DISCOVERY)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(record, f)
+    os.replace(tmp, path)
+
+
+def read_serve_port(train_dir: str) -> Optional[int]:
+    try:
+        with open(os.path.join(train_dir, SERVE_DISCOVERY)) as f:
+            return int(json.load(f)["port"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def serve(cfg: RunConfig) -> int:
+    """CLI entry: start, announce, block until SIGTERM/SIGINT, drain,
+    exit 0 on a clean drain (the contract ``doctor --serve-probe``
+    verifies)."""
+    from tpu_resnet.resilience import ShutdownCoordinator
+
+    coordinator = ShutdownCoordinator(
+        enabled=cfg.resilience.graceful_shutdown,
+        action_desc="draining the predict server (stop accepting, flush "
+                    "the request queue), then exiting 0")
+    server = PredictServer(cfg)
+    clean = True
+    with coordinator:
+        server.start()
+        write_discovery(cfg.train.train_dir, server.port)
+        log.info("serve: ready on :%d — backend=%s model_step=%d "
+                 "buckets=%s max_wait_ms=%s (POST /predict; /metrics; "
+                 "/healthz)", server.port, cfg.serve.backend,
+                 server.backend.model_step, list(server.buckets),
+                 cfg.serve.max_wait_ms)
+        try:
+            while not coordinator.event.wait(0.5):
+                pass
+            log.info("serve: shutdown requested (%s) — draining",
+                     coordinator.signum)
+            clean = server.drain()
+        except KeyboardInterrupt:
+            # Second signal (or coordinator disabled): abort the drain.
+            log.warning("serve: immediate abort requested")
+            clean = False
+        finally:
+            server.close()
+    if clean:
+        log.info("serve: drained cleanly, exiting 0")
+    return 0 if clean else 1

@@ -35,6 +35,13 @@ Checks:
              the config-matrix abstract verifier with golden jaxpr
              hashes — `python -m tpu_resnet check` for operators who
              want one doctor line instead of the full report
+  serve_probe  optional (--serve-probe): a live predict-server smoke —
+             train a tiny MLP, start ``tpu_resnet serve`` on an
+             ephemeral port in a scrubbed CPU subprocess, wait for
+             /healthz readiness, fire predict requests, then SIGTERM
+             and verify the graceful drain exits 0. Proves the whole
+             serving contract (tpu_resnet/serve; docs/SERVING.md) on
+             this machine before a real deployment bets on it.
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -245,6 +252,126 @@ def _check_static_analysis(matrix: bool = True, timeout: int = 900) -> dict:
         return out
 
 
+def _check_serve_probe(timeout: int = 300) -> dict:
+    """Live predict-server drill (tpu_resnet/serve) in scrubbed CPU
+    subprocesses: train a tiny MLP, start ``tpu_resnet serve`` on an
+    ephemeral port, wait for /healthz readiness (model loaded + every
+    bucket compiled), fire a handful of predict requests, scrape
+    /metrics, then SIGTERM and verify the graceful-drain exit-code
+    contract (0 — the supervisor-facing analog of the trainer's 42)."""
+    import signal
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
+    from tpu_resnet.obs.server import parse_prometheus
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_serve_") as d:
+        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
+                     "--preset", "smoke", f"train.train_dir={d}",
+                     "train.train_steps=6", "train.checkpoint_every=3",
+                     "train.log_every=3", "train.summary_every=6",
+                     "train.image_summary_every=0",
+                     "train.steps_per_call=3", "model.name=mlp",
+                     "data.device_resident=off", "data.transfer_stage=1"]
+        rc, out = run_scrubbed_subprocess(train_cmd, n_devices=1,
+                                          timeout=timeout)
+        if rc != 0:
+            return {"ok": False, "phase": "train", "rc": rc,
+                    "tail": out.strip().splitlines()[-5:]}
+        serve_cmd = [sys.executable, "-m", "tpu_resnet", "serve",
+                     "--preset", "smoke", f"train.train_dir={d}",
+                     "model.name=mlp", "data.device_resident=off",
+                     "serve.port=0", "serve.max_batch=4",
+                     "serve.max_wait_ms=5"]
+        # Child output goes to a FILE, not a pipe: nobody reads while we
+        # wait on the server, and a chatty child against a full 64K pipe
+        # would deadlock proc.wait() after SIGTERM.
+        log_path = os.path.join(d, "serve_child.log")
+        log_fh = open(log_path, "w")
+
+        def _tail():
+            log_fh.flush()
+            try:
+                with open(log_path) as f:
+                    return f.read().strip().splitlines()[-5:]
+            except OSError:
+                return []
+
+        proc = subprocess.Popen(serve_cmd, env=scrubbed_cpu_env(1),
+                                stdout=log_fh,
+                                stderr=subprocess.STDOUT, text=True)
+        try:
+            from tpu_resnet.serve.server import read_serve_port
+
+            base, ready = None, False
+            deadline = time.time() + timeout
+            while time.time() < deadline and proc.poll() is None:
+                if base is None:
+                    port = read_serve_port(d)
+                    if port is not None:
+                        base = f"http://127.0.0.1:{port}"
+                if base is not None:
+                    try:
+                        with urllib.request.urlopen(base + "/healthz",
+                                                    timeout=2) as r:
+                            if json.loads(r.read()).get("ok"):
+                                ready = True
+                                break
+                    except (OSError, ValueError):
+                        pass  # 503 (warming) / not listening yet
+                time.sleep(0.3)
+            if not ready:
+                proc.kill()
+                proc.wait(timeout=10)
+                return {"ok": False, "phase": "readiness",
+                        "rc": proc.returncode, "tail": _tail()}
+            ok_requests = 0
+            body = bytes(2 * 32 * 32 * 3)  # two zero CIFAR-shaped images
+            for _ in range(5):
+                req = urllib.request.Request(
+                    base + "/predict", data=body,
+                    headers={"Content-Type": "application/octet-stream",
+                             "X-Shape": "2,32,32,3"})
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        payload = json.loads(r.read())
+                    if len(payload.get("predictions", [])) == 2:
+                        ok_requests += 1
+                except (OSError, ValueError):
+                    pass
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=10) as r:
+                    metrics = parse_prometheus(r.read().decode())
+                served = int(metrics.get("tpu_resnet_serve_requests_total",
+                                         0))
+            except (OSError, ValueError):
+                # A dead/died server is a FAILED check with a tail, not a
+                # doctor crash (every other urlopen here is guarded too).
+                served = -1
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc2 = proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                return {"ok": False, "phase": "drain",
+                        "error": "server did not exit within 60s of "
+                                 "SIGTERM"}
+            result = {"ok": ok_requests == 5 and rc2 == 0 and served >= 5,
+                      "requests_ok": ok_requests, "served_total": served,
+                      "drain_rc": rc2}
+            if not result["ok"]:
+                result["tail"] = _tail()
+            return result
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            log_fh.close()
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -288,7 +415,8 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                probe_timeout: int = 60, mesh_devices: int = 8,
                fault_drill: bool = False, data_bench: bool = False,
                data_bench_secs: float = 4.0, check: bool = False,
-               check_matrix: bool = True, stream=None) -> dict:
+               check_matrix: bool = True, serve_probe: bool = False,
+               stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -321,6 +449,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if fault_drill:
         summary["fault_drill"] = _check_fault_drill()
         emit("fault_drill", summary["fault_drill"])
+    if serve_probe:
+        summary["serve_probe"] = _check_serve_probe()
+        emit("serve_probe", summary["serve_probe"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

@@ -161,3 +161,58 @@ def latest_step_in(directory: str) -> Optional[int]:
         return None
     steps = ocp.utils.checkpoint_steps(directory)
     return max(steps) if steps else None
+
+
+def restore_with_retry(ckpt, template, step: int, retries: int = 3,
+                       backoff_sec: float = 0.5, sleep=None):
+    """Restore ``step`` with bounded exponential-backoff retries.
+
+    The trainer's saves are async: a poller (eval sidecar, serve
+    hot-reload) can see a step whose directory is still mid-commit, and a
+    single transient restore failure used to kill the whole polling loop.
+    Returns the restored state, or None after ``retries`` failures — the
+    caller skips-and-logs the step instead of crashing; the next committed
+    checkpoint restores fine. Shared by ``evaluation/evaluator.py`` and
+    ``serve/backend.py`` (extracted so the backoff/skip-and-log logic
+    can't drift between the two pollers)."""
+    import logging
+    import time
+
+    if sleep is None:
+        sleep = time.sleep
+    log = logging.getLogger("tpu_resnet")
+    for attempt in range(max(1, retries)):
+        try:
+            return ckpt.restore(template, step=step)
+        except Exception as e:  # noqa: BLE001 - any restore failure
+            wait = backoff_sec * (2 ** attempt)
+            log.warning("restore of checkpoint step %d failed "
+                        "(attempt %d/%d, %s: %s)%s", step, attempt + 1,
+                        max(1, retries), type(e).__name__, e,
+                        f"; retrying in {wait:.1f}s"
+                        if attempt + 1 < max(1, retries) else "")
+            if attempt + 1 < max(1, retries):
+                sleep(wait)
+    return None
+
+
+class CheckpointPoller:
+    """Newest-step watcher over a train dir — the shared poll half of the
+    eval sidecar and the serve hot-reload loop. ``poll()`` returns a step
+    exactly once: a step is reported only while it is the newest AND has
+    not been marked seen (``mark_seen`` — callers mark both successful
+    restores and skipped-after-retries steps so the poll never spins on a
+    checkpoint that will not restore)."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        self.last_seen: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        step = latest_step_in(self.directory)
+        if step is not None and step != self.last_seen:
+            return step
+        return None
+
+    def mark_seen(self, step: int) -> None:
+        self.last_seen = int(step)
