@@ -69,16 +69,6 @@ BASELINE_IMAGENET_SPS = 0.96   # reference README.md:48 (1ps-1wk b128)
 
 HEADLINE_METRIC = "cifar10_resnet50_train_steps_per_sec_b128"
 
-# Peak dense bf16 FLOP/s per chip by device_kind substring (public specs).
-# Order matters: check the more specific names first.
-_PEAK_FLOPS = [
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v6 lite", 918e12), ("v6e", 918e12),
-    ("v4", 275e12),
-]
-
-
 def _print_line(text: str) -> None:
     """Emit one stdout line as a SINGLE write + flush. ``print`` may split
     string and newline across writes, so a SIGKILL could land between them
@@ -92,14 +82,13 @@ def _print_line(text: str) -> None:
 
 
 def _peak_flops(device_kind: str):
-    env = os.environ.get("BENCH_PEAK_FLOPS")
-    if env:
-        return float(env)
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    """Peak dense bf16 FLOP/s per chip — the shared table now lives with
+    the MFU accounting layer (tpu_resnet/obs/mfu.py, jax-free import);
+    BENCH_PEAK_FLOPS still overrides. Imported lazily so the parent
+    orchestrator keeps its no-package-import startup path."""
+    from tpu_resnet.obs.mfu import peak_flops_per_chip
+
+    return peak_flops_per_chip(device_kind)
 
 
 # --------------------------------------------------------------------------
@@ -302,17 +291,13 @@ def _measure_cifar_streaming(mesh, warmup_super, measure_super, stage=8,
 def _train_step_flops(compiled):
     """Per-step, per-device FLOPs from XLA's compiled cost analysis (the
     post-SPMD module is per-device); None if the backend doesn't report
-    them."""
+    them. Extraction shared with the live gauges (obs/mfu.py)."""
+    from tpu_resnet.obs.mfu import program_flops
+
     try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-        flops = (cost or {}).get("flops")
-        if flops and flops > 0:
-            return float(flops)
+        return program_flops(compiled.cost_analysis())
     except Exception:
-        pass
-    return None
+        return None
 
 
 def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
@@ -525,6 +510,48 @@ def _measure_pallas_ab(iters=200):
     return out
 
 
+# Rough per-section wall-time estimates (seconds, cache-cold TPU child —
+# r3 battery log) used by the child's wall-clock budget gate: a section
+# that cannot finish before BENCH_CHILD_DEADLINE is skipped WITH a
+# marker, so a tight budget degrades to fewer sections in a complete,
+# parseable final line — never to a mid-print kill (round-4 postmortem:
+# BENCH_r04 recorded rc=124, parsed=null).
+_SECTION_EST = {
+    "cifar_streaming": 120, "imagenet": 240, "imagenet_b2": 180,
+    "imagenet_stem_ab": 180, "wrn28_10_cifar100": 150,
+    "pallas_xent_ab": 90, "host_decode": 60, "record_split": 30,
+}
+
+
+def _child_deadline():
+    """Absolute wall-clock deadline handed down by the parent
+    (``BENCH_CHILD_DEADLINE``, epoch seconds); None = unbounded."""
+    try:
+        return float(os.environ.get("BENCH_CHILD_DEADLINE") or 0) or None
+    except ValueError:
+        return None
+
+
+def _section_est(name: str) -> float:
+    """Estimate for a section by its RESULT key — the secondary-ImageNet
+    section's key embeds the configured batch (``imagenet_b256``), so it
+    must normalize to the table's ``imagenet_b2`` row rather than fall
+    through to the default (which under-gates it by 60s — enough to blow
+    the parent's SIGKILL margin, the exact failure the gate prevents)."""
+    if re.fullmatch(r"imagenet_b\d+", name):
+        name = "imagenet_b2"
+    return _SECTION_EST.get(name, 120)
+
+
+def _section_fits(deadline, est_sec, now=None) -> bool:
+    """Budget gate: can a section estimated at ``est_sec`` finish before
+    ``deadline``? Pure so the skip policy is unit-testable."""
+    if deadline is None:
+        return True
+    now = time.time() if now is None else now
+    return now + est_sec <= deadline
+
+
 def run_child(kind: str) -> None:
     """Run the measurements on the ambient backend; final stdout line is
     ``RESULT_JSON: {...}`` for the parent. Progress goes to stderr."""
@@ -544,6 +571,19 @@ def run_child(kind: str) -> None:
     result = {"backend": jax.default_backend(), "device_kind": kinds,
               "n_devices": len(devices)}
     errors = {}
+    deadline = _child_deadline()
+
+    def fits(name: str) -> bool:
+        """Wall-clock budget gate for one section; a skip is recorded in
+        the errors map so the final line says WHAT was dropped and why
+        (silent truncation would read as 'covered everything')."""
+        if _section_fits(deadline, _section_est(name)):
+            return True
+        errors[name] = ("skipped: section does not fit the remaining "
+                        "wall-clock budget (BENCH_CHILD_DEADLINE)")
+        print(f"[bench child] skipping {name}: budget exhausted",
+              file=sys.stderr)
+        return False
 
     def snapshot():
         """Emit the current result as a RESULT_JSON line. Later lines
@@ -580,17 +620,18 @@ def run_child(kind: str) -> None:
     snapshot()
 
     if kind == "tpu":
-        try:
-            s_sps, s_bd = _measure_cifar_streaming(mesh, warmup_super=2,
-                                                   measure_super=12)
-            result["cifar_streaming"] = {
-                "steps_per_sec": round(s_sps, 2),
-                "vs_baseline": round(s_sps / BASELINE_CIFAR_SPS, 2),
-                **s_bd}
-            print(f"[bench child] cifar streaming: {s_sps:.2f} steps/s",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["cifar_streaming"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("cifar_streaming"):
+            try:
+                s_sps, s_bd = _measure_cifar_streaming(mesh, warmup_super=2,
+                                                       measure_super=12)
+                result["cifar_streaming"] = {
+                    "steps_per_sec": round(s_sps, 2),
+                    "vs_baseline": round(s_sps / BASELINE_CIFAR_SPS, 2),
+                    **s_bd}
+                print(f"[bench child] cifar streaming: {s_sps:.2f} steps/s",
+                      file=sys.stderr)
+            except Exception as e:
+                errors["cifar_streaming"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
         def imagenet_entry(sps, flops, batch):
             """steps/s + images/s + MFU from per-device FLOPs (XLA cost
@@ -614,17 +655,20 @@ def run_child(kind: str) -> None:
                 entry["peak_flops_assumed_per_chip"] = peak
             return entry
 
-        try:
-            inet_sps, flops = _measure_imagenet(mesh, warmup_steps=5,
-                                                measure_steps=30)
-            entry = imagenet_entry(inet_sps, flops, 128)
-            entry["metric"] = "imagenet_resnet50_train_steps_per_sec_b128"
-            entry["vs_baseline"] = round(inet_sps / BASELINE_IMAGENET_SPS, 2)
-            result["imagenet"] = entry
-            print(f"[bench child] imagenet: {inet_sps:.3f} steps/s "
-                  f"mfu={entry.get('mfu')}", file=sys.stderr)
-        except Exception as e:
-            errors["imagenet"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("imagenet"):
+            try:
+                inet_sps, flops = _measure_imagenet(mesh, warmup_steps=5,
+                                                    measure_steps=30)
+                entry = imagenet_entry(inet_sps, flops, 128)
+                entry["metric"] = \
+                    "imagenet_resnet50_train_steps_per_sec_b128"
+                entry["vs_baseline"] = round(
+                    inet_sps / BASELINE_IMAGENET_SPS, 2)
+                result["imagenet"] = entry
+                print(f"[bench child] imagenet: {inet_sps:.3f} steps/s "
+                      f"mfu={entry.get('mfu')}", file=sys.stderr)
+            except Exception as e:
+                errors["imagenet"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
         # Secondary ImageNet entry at a larger batch: the b128 line stays
         # the baseline-comparable headline; this one shows how utilization
@@ -633,7 +677,7 @@ def run_child(kind: str) -> None:
             b2 = int(os.environ.get("BENCH_IMAGENET_BATCH2") or "256")
         except ValueError:
             b2 = 0
-        if b2:
+        if b2 and fits(f"imagenet_b{b2}"):
             try:
                 sps2, flops2 = _measure_imagenet(
                     mesh, warmup_steps=3, measure_steps=15, batch=b2)
@@ -647,57 +691,63 @@ def run_child(kind: str) -> None:
         # Stem A/B: the space-to-depth stem (default ON, exact-equivalent
         # math) vs the plain 7x7/2 form — records what the optimization
         # buys on this chip at the headline batch.
-        try:
-            sps_plain, _ = _measure_imagenet(mesh, warmup_steps=3,
-                                             measure_steps=15,
-                                             stem_s2d=False)
-            base = result.get("imagenet", {}).get("value")
-            result["imagenet_stem_ab"] = {
-                "plain_stem_steps_per_sec": round(sps_plain, 3),
-                "s2d_stem_steps_per_sec": base,
-                "s2d_speedup": (round(base / sps_plain, 3)
-                                if base else None)}
-            print(f"[bench child] stem A/B: {result['imagenet_stem_ab']}",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["imagenet_stem_ab"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("imagenet_stem_ab"):
+            try:
+                sps_plain, _ = _measure_imagenet(mesh, warmup_steps=3,
+                                                 measure_steps=15,
+                                                 stem_s2d=False)
+                base = result.get("imagenet", {}).get("value")
+                result["imagenet_stem_ab"] = {
+                    "plain_stem_steps_per_sec": round(sps_plain, 3),
+                    "s2d_stem_steps_per_sec": base,
+                    "s2d_speedup": (round(base / sps_plain, 3)
+                                    if base else None)}
+                print(f"[bench child] stem A/B: "
+                      f"{result['imagenet_stem_ab']}", file=sys.stderr)
+            except Exception as e:
+                errors["imagenet_stem_ab"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
         # BASELINE.json config 4: Wide-ResNet-28-10 CIFAR-100 b128 — the
         # reference's wide-variant exercise, no published speed line (the
         # entry records our absolute number for cross-round tracking).
-        try:
-            wrn_batch = 128
-            wrn = _measure_cifar(mesh, [(10, 2, 10)],
-                                 preset="wrn28_10_cifar100",
-                                 batch=wrn_batch)
-            result["wrn28_10_cifar100"] = {
-                "steps_per_sec": round(wrn[10], 2),
-                "images_per_sec": round(wrn[10] * wrn_batch, 1)}
-            print(f"[bench child] wrn28-10: {wrn[10]:.2f} steps/s",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["wrn28_10_cifar100"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("wrn28_10_cifar100"):
+            try:
+                wrn_batch = 128
+                wrn = _measure_cifar(mesh, [(10, 2, 10)],
+                                     preset="wrn28_10_cifar100",
+                                     batch=wrn_batch)
+                result["wrn28_10_cifar100"] = {
+                    "steps_per_sec": round(wrn[10], 2),
+                    "images_per_sec": round(wrn[10] * wrn_batch, 1)}
+                print(f"[bench child] wrn28-10: {wrn[10]:.2f} steps/s",
+                      file=sys.stderr)
+            except Exception as e:
+                errors["wrn28_10_cifar100"] = \
+                    f"{type(e).__name__}: {e}"[:500]
         snapshot()
-        try:
-            result["pallas_xent_ab"] = _measure_pallas_ab()
-            print(f"[bench child] pallas A/B: {result['pallas_xent_ab']}",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["pallas_xent_ab"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("pallas_xent_ab"):
+            try:
+                result["pallas_xent_ab"] = _measure_pallas_ab()
+                print(f"[bench child] pallas A/B: "
+                      f"{result['pallas_xent_ab']}", file=sys.stderr)
+            except Exception as e:
+                errors["pallas_xent_ab"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
-        try:
-            result["host_decode"] = _measure_host_decode()
-            print(f"[bench child] host decode: {result['host_decode']}",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["host_decode"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("host_decode"):
+            try:
+                result["host_decode"] = _measure_host_decode()
+                print(f"[bench child] host decode: "
+                      f"{result['host_decode']}", file=sys.stderr)
+            except Exception as e:
+                errors["host_decode"] = f"{type(e).__name__}: {e}"[:500]
         snapshot()
-        try:
-            result["record_split"] = _measure_record_split()
-            print(f"[bench child] record split: {result['record_split']}",
-                  file=sys.stderr)
-        except Exception as e:
-            errors["record_split"] = f"{type(e).__name__}: {e}"[:500]
+        if fits("record_split"):
+            try:
+                result["record_split"] = _measure_record_split()
+                print(f"[bench child] record split: "
+                      f"{result['record_split']}", file=sys.stderr)
+            except Exception as e:
+                errors["record_split"] = f"{type(e).__name__}: {e}"[:500]
 
     snapshot()
 
@@ -1066,8 +1116,16 @@ def main():
             diags.append(f"live at probe{probes} but only {eff_timeout}s "
                          "headroom — skipping child")
             break
+        # The child gets an absolute wall-clock deadline slightly inside
+        # its kill timeout: sections that no longer fit are SKIPPED with
+        # a marker and the final line is flushed complete, instead of the
+        # parent's SIGKILL truncating it mid-print (BENCH_r04: rc=124,
+        # parsed=null).
+        child_env = dict(os.environ)
+        child_env["BENCH_CHILD_DEADLINE"] = str(
+            time.time() + max(60, eff_timeout - 30))
         rc, out = _run([sys.executable, me, "--child", "tpu"],
-                       dict(os.environ), eff_timeout)
+                       child_env, eff_timeout)
         sys.stderr.write(out)
         result = _parse_result(out)
         if result and rc == 0:

@@ -1,0 +1,17 @@
+"""Seeded jit-host-sync violation (fixture for tests/test_analysis.py):
+MFU cost-analysis introspection inside the jitted hot path.
+
+obs/mfu.py's accounting (.lower().cost_analysis()) is a one-time host
+startup cost; calling it per step from jit scope re-traces the program
+on every dispatch. The rule must flag it here (jit-scope path)."""
+
+
+def make_train_step(step_fn, state, images, labels):
+    def train_step(state, images, labels):
+        # Per-step compile introspection: must be flagged.
+        flops = step_fn.lower(state, images, labels).cost_analysis()
+        new_state, metrics = step_fn(state, images, labels)
+        metrics["flops"] = flops
+        return new_state, metrics
+
+    return train_step

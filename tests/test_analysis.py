@@ -170,6 +170,19 @@ def test_serve_host_sync_fixture():
     assert not any("clean_helper" in f.message for f in found)
 
 
+def test_mfu_cost_analysis_in_jit_scope_fixture():
+    """obs/mfu.py's compile introspection (.cost_analysis()) is a
+    one-time host-side startup cost: the rule flags it inside jit-scope
+    modules so accounting can never creep into the per-step hot path —
+    while the real obs/mfu.py (host-side, outside jit scope) stays
+    clean (covered by test_repo_is_clean)."""
+    found = fixture_findings("mfu_jit_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    assert ".cost_analysis()" in msgs
+    assert "never per step" in msgs
+    assert all(f.path == "tpu_resnet/train/step.py" for f in found)
+
+
 def test_serve_signal_fixture():
     """The serve SIGTERM anti-pattern (drain/teardown inline in the
     handler instead of a flag) is in the signal-safety covered set."""

@@ -397,3 +397,39 @@ def test_sigterm_flush_carries_cached_snapshot():
     assert "snapshot" not in cache
     assert cache["value"] is not None
     assert len(json.dumps(line)) < 1500
+
+
+def test_child_budget_gate_skips_sections_that_do_not_fit():
+    """The wall-clock budget gate (BENCH_r04 fix): sections whose
+    estimate does not fit before the deadline are skipped; a fitting
+    section runs; no deadline = everything fits."""
+    now = 1000.0
+    assert bench._section_fits(None, 9999, now=now)
+    assert bench._section_fits(now + 100, 60, now=now)
+    assert not bench._section_fits(now + 100, 240, now=now)
+    # boundary: exactly fitting is allowed
+    assert bench._section_fits(now + 60, 60, now=now)
+    # every gated section has an estimate entry (or falls back sanely)
+    for name in ("cifar_streaming", "imagenet", "imagenet_stem_ab",
+                 "wrn28_10_cifar100", "pallas_xent_ab", "host_decode",
+                 "record_split"):
+        assert bench._section_est(name) == bench._SECTION_EST[name] > 0
+    # the secondary-ImageNet section key embeds the configured batch:
+    # any imagenet_b<N> must resolve to the imagenet_b2 table row, not
+    # the (smaller) default — under-gating it can blow the SIGKILL margin
+    assert bench._section_est("imagenet_b256") == \
+        bench._SECTION_EST["imagenet_b2"]
+    assert bench._section_est("imagenet_b512") == \
+        bench._SECTION_EST["imagenet_b2"]
+    assert bench._section_est("unknown_section") == 120
+
+
+def test_child_deadline_env_parsing(monkeypatch):
+    monkeypatch.delenv("BENCH_CHILD_DEADLINE", raising=False)
+    assert bench._child_deadline() is None
+    monkeypatch.setenv("BENCH_CHILD_DEADLINE", "123.5")
+    assert bench._child_deadline() == 123.5
+    monkeypatch.setenv("BENCH_CHILD_DEADLINE", "junk")
+    assert bench._child_deadline() is None
+    monkeypatch.setenv("BENCH_CHILD_DEADLINE", "0")
+    assert bench._child_deadline() is None  # 0 = unset sentinel

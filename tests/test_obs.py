@@ -13,8 +13,13 @@ import pytest
 
 from tpu_resnet import obs
 from tpu_resnet.obs.server import (
+    CORE_HISTOGRAMS,
+    Histogram,
+    LATENCY_BUCKETS_MS,
     TelemetryRegistry,
     TelemetryServer,
+    histogram_quantile,
+    parse_histograms,
     parse_prometheus,
     read_telemetry_port,
     scrape,
@@ -119,7 +124,7 @@ def test_manifest_schema_and_atomic_write(tmp_path):
     assert os.listdir(tmp_path) == ["manifest.json"]  # no tmp leftovers
     with open(path) as f:
         m = json.load(f)
-    assert m["schema"] == 1
+    assert m["schema"] == 2
     assert m["config"]["train"]["train_steps"] == cfg.train.train_steps
     assert m["mesh"]["shape"] and m["mesh"]["axis_names"]
     assert m["devices"]["count"] == mesh.size
@@ -300,8 +305,11 @@ def test_doctor_telemetry_check(tmp_path):
 def test_obs_scrape_tool(tmp_path, capsys):
     from tpu_resnet.tools import obs_scrape
 
-    reg = TelemetryRegistry(stale_after_sec=60.0)
+    # histograms included so --json must serialize the +Inf bucket edge
+    reg = TelemetryRegistry(stale_after_sec=60.0,
+                            histograms=CORE_HISTOGRAMS)
     reg.heartbeat(11)
+    reg.observe("train_step_ms", 12.5, n=3)
     srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
     try:
         assert obs_scrape.main(["--dir", str(tmp_path)]) == 0
@@ -311,9 +319,146 @@ def test_obs_scrape_tool(tmp_path, capsys):
 
         assert obs_scrape.main(
             ["--url", f"127.0.0.1:{srv.port}", "--json"]) == 0
-        report = json.loads(capsys.readouterr().out)
+        raw = capsys.readouterr().out
+        # strict JSON: the +Inf histogram bucket edge must serialize as
+        # the string "+Inf", never a bare Infinity literal
+        assert "Infinity" not in raw
+        report = json.loads(raw)
         assert report["metrics"]["tpu_resnet_step"] == 11.0
     finally:
         srv.close()
     assert obs_scrape.main(["--dir", str(tmp_path / "none")]) == 2
     assert obs_scrape.main(["--dir", str(tmp_path), "--timeout", "2"]) == 1
+
+
+# ------------------------------------------------------------ histograms
+
+def test_histogram_percentiles_vs_numpy_reference():
+    """Bucket/percentile math against a numpy reference: with bucket
+    edges placed densely around the data, the interpolated estimate must
+    track np.percentile within one bucket width."""
+    rng = np.random.RandomState(0)
+    values = rng.gamma(shape=2.0, scale=30.0, size=5000)  # latency-ish
+    edges = tuple(float(e) for e in np.linspace(1, 500, 100))
+    h = Histogram("lat", edges=edges)
+    for v in values:
+        h.observe(v)
+    width = edges[1] - edges[0]
+    for q in (0.50, 0.90, 0.95, 0.99):
+        ref = float(np.percentile(values, q * 100))
+        got = h.percentile(q)
+        assert abs(got - ref) <= width + 1e-9, (q, got, ref)
+
+
+def test_histogram_exposition_round_trip():
+    """render() emits valid Prometheus histogram exposition that
+    parse_histograms reconstructs exactly (cumulative buckets, sum,
+    count) — and histogram_quantile agrees on both sides."""
+    h = Histogram("serve_latency_ms", "help text",
+                  edges=(1.0, 10.0, 100.0))
+    for v in (0.5, 3.0, 3.0, 50.0, 400.0):
+        h.observe(v)
+    text = "\n".join(h.render()) + "\n"
+    assert '# TYPE tpu_resnet_serve_latency_ms histogram' in text
+    assert 'tpu_resnet_serve_latency_ms_bucket{le="+Inf"} 5' in text
+    parsed = parse_histograms(text)
+    snap = parsed["tpu_resnet_serve_latency_ms"]
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(456.5)
+    assert snap["buckets"][:3] == [(1.0, 1), (10.0, 3), (100.0, 4)]
+    assert snap["buckets"][3][1] == 5  # +Inf cumulative
+    for q in (0.1, 0.5, 0.9):
+        assert histogram_quantile(snap, q) == pytest.approx(
+            h.percentile(q))
+    # plain-gauge parser still accepts the same text (histogram series
+    # collapse instead of crashing)
+    flat = parse_prometheus(text)
+    assert flat["tpu_resnet_serve_latency_ms_count"] == 5.0
+
+
+def test_histogram_weighted_observe_and_edge_cases():
+    h = Histogram("x", edges=(10.0, 20.0))
+    h.observe(5.0, n=9)   # the train loop's interval form
+    h.observe(15.0)
+    assert h.snapshot()["count"] == 10
+    assert h.percentile(0.5) == pytest.approx(
+        np.interp(5, [0, 9], [0, 10]), abs=10.0)
+    assert histogram_quantile({"buckets": [], "count": 0}, 0.5) == 0.0
+    assert Histogram("y").snapshot()["count"] == 0
+    with pytest.raises(ValueError):
+        Histogram("bad", edges=(3.0, 2.0))
+
+
+def test_registry_histograms_predeclared_and_live(tmp_path):
+    """Pre-declared histograms render empty buckets before the first
+    observation; observe()/hist_percentile() flow through a live scrape
+    as real percentile data."""
+    reg = TelemetryRegistry(stale_after_sec=60.0,
+                            histograms=CORE_HISTOGRAMS)
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        report = scrape(f"127.0.0.1:{srv.port}")
+        hist = report["histograms"]["tpu_resnet_train_step_ms"]
+        assert hist["count"] == 0  # pre-declared, empty — not absent
+        for ms, n in ((5.0, 18), (7.0, 18), (40.0, 4)):
+            reg.observe("train_step_ms", ms, n=n)
+        report = scrape(f"127.0.0.1:{srv.port}")
+        hist = report["histograms"]["tpu_resnet_train_step_ms"]
+        assert hist["count"] == 40
+        p50 = histogram_quantile(hist, 0.50)
+        p99 = histogram_quantile(hist, 0.99)
+        assert 0 < p50 <= 10.0 < p99 <= 50.0
+        assert reg.hist_percentile("train_step_ms", 0.5) == pytest.approx(
+            p50)
+        # undeclared names auto-create with default latency buckets
+        reg.observe("adhoc_ms", 3.0)
+        assert reg.hist_percentile("adhoc_ms", 0.5) > 0
+    finally:
+        srv.close()
+
+
+def test_core_gauges_include_mfu_series(tmp_path):
+    reg = TelemetryRegistry()
+    srv = TelemetryServer.maybe_start(0, reg, train_dir=str(tmp_path))
+    try:
+        metrics = scrape(f"127.0.0.1:{srv.port}")["metrics"]
+        assert metrics["tpu_resnet_mfu"] == 0.0  # pre-declared
+        assert metrics["tpu_resnet_model_flops_per_sec"] == 0.0
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- run_id
+
+def test_run_id_minted_once_and_shared(tmp_path):
+    d = str(tmp_path)
+    assert obs.read_run_id(d) is None  # read-only consumers: no minting
+    rid = obs.ensure_run_id(d)
+    assert rid and len(rid) == 12
+    assert obs.ensure_run_id(d) == rid      # stable across resumes
+    assert obs.read_run_id(d) == rid        # sidecars see the same id
+    with open(tmp_path / "run_id.json") as f:
+        assert json.load(f)["run_id"] == rid
+
+
+def test_span_tracer_stamps_run_id_and_pid(tmp_path):
+    tr = obs.SpanTracer(str(tmp_path), run_id="abc123")
+    tr.event("marker", step=1)
+    tr.run_id = "late-id"  # mutable: sidecar discovers the id later
+    tr.event("marker2")
+    tr.close()
+    spans = load_spans(str(tmp_path / "events.jsonl"))
+    assert [s["run_id"] for s in spans] == ["abc123", "late-id"]
+    assert all(s["pid"] == os.getpid() for s in spans)
+
+
+def test_manifest_carries_run_id(tmp_path):
+    from tpu_resnet import parallel
+    from tpu_resnet.config import load_config
+
+    cfg = load_config("smoke")
+    mesh = parallel.create_mesh(cfg.mesh)
+    rid = obs.ensure_run_id(str(tmp_path))
+    obs.write_manifest(str(tmp_path), cfg, mesh, run_id=rid)
+    with open(tmp_path / "manifest.json") as f:
+        assert json.load(f)["run_id"] == rid

@@ -1,0 +1,152 @@
+"""Perf-regression tracker (tools/perfwatch.py): trajectory parsing
+(driver rounds, archived chip artifacts, truncated tails), backend
+cohorting, and noise-band verdicts on seeded regressing/flat/improving
+trajectories."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "perfwatch", os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "perfwatch.py"))
+perfwatch = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(perfwatch)
+
+
+def _bench_record(value, backend="tpu", mfu=None, imagenet_sps=None):
+    rec = {"metric": perfwatch.HEADLINE_METRIC, "value": value,
+           "unit": "steps/sec", "backend": backend,
+           "device_kind": "TPU v5 lite", "n_devices": 1}
+    if mfu is not None or imagenet_sps is not None:
+        rec["imagenet"] = {"value": imagenet_sps, "mfu": mfu}
+    return rec
+
+
+def _seed_root(tmp_path, values, backend="tpu", mfus=None):
+    """Write one driver-round file per value (oldest first)."""
+    root = str(tmp_path)
+    for i, v in enumerate(values, start=1):
+        rec = _bench_record(v, backend=backend,
+                            mfu=mfus[i - 1] if mfus else None,
+                            imagenet_sps=10.0 if mfus else None)
+        with open(os.path.join(root, f"BENCH_r{i:02d}.json"), "w") as f:
+            json.dump({"n": i, "rc": 0, "parsed": rec, "tail": ""}, f)
+    return root
+
+
+# ----------------------------------------------------------- trajectories
+
+def test_regressing_trajectory_fails(tmp_path):
+    root = _seed_root(tmp_path, [200.0, 205.0, 198.0, 150.0])
+    verdict = perfwatch.judge(perfwatch.load_samples(root), noise=0.08)
+    m = verdict["metrics"]["cifar_steps_per_sec"]
+    assert m["verdict"] == "regress"
+    assert m["latest"] == 150.0
+    assert m["reference"] == 200.0  # median of the priors
+    assert verdict["overall"] == "regress"
+    assert perfwatch.main(["--root", root]) == 1  # exit-code contract
+
+
+def test_flat_trajectory_passes_inside_noise_band(tmp_path):
+    root = _seed_root(tmp_path, [200.0, 205.0, 198.0, 193.0])
+    verdict = perfwatch.judge(perfwatch.load_samples(root), noise=0.08)
+    assert verdict["metrics"]["cifar_steps_per_sec"]["verdict"] == "flat"
+    assert verdict["overall"] == "flat"
+    assert perfwatch.main(["--root", root]) == 0
+
+
+def test_improving_trajectory_reports_improve(tmp_path):
+    root = _seed_root(tmp_path, [200.0, 205.0, 198.0, 240.0],
+                      mfus=[0.30, 0.31, 0.30, 0.41])
+    verdict = perfwatch.judge(perfwatch.load_samples(root), noise=0.08)
+    assert verdict["metrics"]["cifar_steps_per_sec"]["verdict"] == \
+        "improve"
+    assert verdict["metrics"]["imagenet_mfu"]["verdict"] == "improve"
+    assert verdict["overall"] == "improve"
+    assert perfwatch.main(["--root", root]) == 0
+
+
+def test_insufficient_data(tmp_path):
+    root = _seed_root(tmp_path, [200.0])
+    verdict = perfwatch.judge(perfwatch.load_samples(root))
+    assert verdict["metrics"]["cifar_steps_per_sec"]["verdict"] == \
+        "insufficient_data"
+    assert verdict["overall"] == "insufficient_data"
+    assert perfwatch.main(["--root", root]) == 0
+
+
+# --------------------------------------------------- cohorts + salvage
+
+def test_cpu_fallback_round_never_judged_against_chip_numbers(tmp_path):
+    """The BENCH_r02/r03 shape: chip rounds then a CPU-fallback round.
+    The latest (cpu) sample has no cpu predecessors — the verdict must
+    be insufficient_data, NOT a 99.99% regression vs the TPU median."""
+    root = _seed_root(tmp_path, [200.0, 205.0, 210.0])
+    with open(os.path.join(root, "BENCH_r04.json"), "w") as f:
+        json.dump({"n": 4, "rc": 0, "tail": "",
+                   "parsed": _bench_record(0.03, backend="cpu")}, f)
+    verdict = perfwatch.judge(perfwatch.load_samples(root))
+    m = verdict["metrics"]["cifar_steps_per_sec"]
+    assert m["backend"] == "cpu"
+    assert m["verdict"] == "insufficient_data"
+
+
+def test_salvage_from_tail_and_truncated_line(tmp_path):
+    """parsed=null rounds recover their record from the stdout tail (the
+    BENCH_r04 failure mode); a tail holding only a truncated JSON line
+    yields no sample but is reported as unparseable."""
+    root = str(tmp_path)
+    good = json.dumps(_bench_record(150.0))
+    with open(os.path.join(root, "BENCH_r01.json"), "w") as f:
+        json.dump({"n": 1, "rc": 124, "parsed": None,
+                   "tail": f"noise\nRESULT_JSON: {good}\nmore noise"}, f)
+    with open(os.path.join(root, "BENCH_r02.json"), "w") as f:
+        json.dump({"n": 2, "rc": 124, "parsed": None,
+                   "tail": good + "\n" + good[:40]}, f)  # torn last line
+    with open(os.path.join(root, "BENCH_r03.json"), "w") as f:
+        json.dump({"n": 3, "rc": 124, "parsed": None,
+                   "tail": "rom an earlier live tunnel window truncated"},
+                  f)
+    samples = perfwatch.load_samples(root)
+    values = [s["value"] for s in samples if s.get("metric") ==
+              "cifar_steps_per_sec"]
+    assert values == [150.0, 150.0]  # r01 prefixed + r02 bare emit line
+    assert any("BENCH_r03" in s.get("source", "") for s in samples
+               if "error" in s)
+
+
+def test_archived_chip_artifact_and_extra_file_ordering(tmp_path):
+    """docs/runs chip artifacts sort with their round; --add files are
+    judged as the newest run."""
+    root = _seed_root(tmp_path, [0.03, 0.02], backend="cpu")
+    runs = os.path.join(root, "docs", "runs")
+    os.makedirs(runs)
+    for rnd, v in ((1, 200.0), (2, 204.0)):
+        with open(os.path.join(runs, f"bench_r{rnd}_tpu_v5e.json"),
+                  "w") as f:
+            json.dump(_bench_record(v), f)
+    new = os.path.join(root, "new_run.json")
+    with open(new, "w") as f:
+        json.dump(_bench_record(150.0), f)
+    verdict = perfwatch.judge(perfwatch.load_samples(root,
+                                                     extra_files=[new]))
+    m = verdict["metrics"]["cifar_steps_per_sec"]
+    assert m["backend"] == "tpu"          # cohort of the newest sample
+    assert m["latest"] == 150.0
+    assert m["reference"] == pytest.approx(202.0)
+    assert m["verdict"] == "regress"
+
+
+def test_verdict_json_output(tmp_path, capsys):
+    root = _seed_root(tmp_path, [200.0, 100.0])
+    out = str(tmp_path / "v.json")
+    rc = perfwatch.main(["--root", root, "--json", out])
+    assert rc == 1
+    with open(out) as f:
+        verdict = json.load(f)
+    assert verdict["overall"] == "regress"
+    stdout = capsys.readouterr().out
+    assert "PERFWATCH_JSON:" in stdout and "regress" in stdout

@@ -710,3 +710,75 @@ def test_doctor_serve_probe_contract():
     assert out["ok"], out
     assert out["requests_ok"] == 5 and out["drain_rc"] == 0
     assert out["served_total"] >= 5
+
+
+def test_serve_latency_histograms_and_run_id(fake_server, tmp_path):
+    """The histogram exposition replaces the scalar-gauge-only view:
+    after real traffic, /metrics carries serve_latency_ms /
+    serve_queue_wait_ms / serve_pad_fraction histogram series with
+    consistent counts, and /info + serve.json expose the run_id of the
+    served train_dir."""
+    from tpu_resnet.obs.server import (histogram_quantile,
+                                       parse_histograms)
+    from tpu_resnet.serve.server import write_discovery
+
+    srv, backend = fake_server
+    # pre-traffic: series pre-declared, empty — present, not absent
+    _, body = _get(srv.port, "/metrics")
+    hists = parse_histograms(body.decode())
+    assert hists["tpu_resnet_serve_latency_ms"]["count"] == 0
+    n_req = 6
+    for i in range(n_req):
+        img = np.full((1, 8, 8, 3), i, np.uint8)
+        status, _ = _post(srv.port, img.tobytes(), shape="1,8,8,3")
+        assert status == 200
+    _, body = _get(srv.port, "/metrics")
+    text = body.decode()
+    hists = parse_histograms(text)
+    lat = hists["tpu_resnet_serve_latency_ms"]
+    wait = hists["tpu_resnet_serve_queue_wait_ms"]
+    pad = hists["tpu_resnet_serve_pad_fraction"]
+    assert lat["count"] == n_req == wait["count"]
+    assert pad["count"] >= 1  # one sample per dispatched batch
+    assert 0 < histogram_quantile(lat, 0.5) <= \
+        histogram_quantile(lat, 0.99)
+    # queue wait is bounded by latency for every request
+    assert histogram_quantile(wait, 0.5) <= histogram_quantile(lat, 0.99)
+    assert lat["sum"] >= wait["sum"] >= 0
+
+    # run_id: no train run in this dir → honest null in /info, and
+    # write_discovery records whatever the server resolved
+    _, body = _get(srv.port, "/info")
+    info = json.loads(body)
+    assert "run_id" in info and info["run_id"] is None
+    write_discovery(str(tmp_path), srv.port, run_id="abc123def456")
+    with open(tmp_path / "serve.json") as f:
+        assert json.load(f)["run_id"] == "abc123def456"
+
+
+def test_serve_spans_written_with_run_id(tmp_path):
+    """serve() components write serve_events.jsonl spans (warmup, drain)
+    stamped with the train_dir's run_id — the serve lane trace-export
+    renders."""
+    from tpu_resnet.obs import ensure_run_id
+    from tpu_resnet.obs.spans import SpanTracer, load_spans
+    from tpu_resnet.obs.trace import SERVE_EVENTS_FILE
+
+    cfg = _serve_cfg()
+    cfg.train.train_dir = str(tmp_path)
+    rid = ensure_run_id(str(tmp_path))
+    spans = SpanTracer(str(tmp_path), filename=SERVE_EVENTS_FILE,
+                       run_id=rid)
+    srv = PredictServer(cfg, backend=FakeBackend(), spans=spans).start()
+    assert srv.run_id == rid  # resolved from the served train_dir
+    img = np.zeros((1, 8, 8, 3), np.uint8)
+    assert _post(srv.port, img.tobytes(), shape="1,8,8,3")[0] == 200
+    srv.drain(5.0)
+    srv.close()
+    spans.close()
+    recs = load_spans(str(tmp_path / SERVE_EVENTS_FILE))
+    kinds = [r["span"] for r in recs]
+    assert kinds[0] == "serve_warmup" and "serve_drain" in kinds
+    assert all(r["run_id"] == rid for r in recs)
+    drain = next(r for r in recs if r["span"] == "serve_drain")
+    assert drain["clean"] is True

@@ -167,6 +167,10 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
     ns = "tpu_resnet_"
     result = {
         "mode": mode, "clients": clients, "duration_sec": round(wall, 2),
+        # Correlation id of the served train_dir (serve /info exposes the
+        # run_id obs/manifest.py minted) — joins this RESULT_JSON to the
+        # same trace-export timeline as the trainer/eval/serve events.
+        "run_id": info.get("run_id"),
         "images_per_request": images_per_request,
         "offered_qps": qps if mode == "open" else None,
         "requests_ok": ok, "rejected_429": rejected, "failed": failed,

@@ -111,6 +111,8 @@ def main():
     # async collective form some backends emit
     interesting["all-reduce"] += ops.get("all-reduce-start", 0)
 
+    from tpu_resnet.obs.mfu import program_flops
+
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
         cost = cost[0]
@@ -127,8 +129,11 @@ def main():
     sps = args.steps / (time.perf_counter() - t0)
 
     kind = jax.devices()[0].device_kind
+    # Shared tables/extraction: tpu_resnet/obs/mfu.py is the one home of
+    # the peak-FLOPs table and the cost-analysis parsing; the probe's MFU
+    # is computed exactly like the live gauge's.
     peak = bench._peak_flops(kind)
-    flops = float(cost.get("flops", 0) or 0)
+    flops = program_flops(cost) or 0.0
     out = {
         "backend": jax.default_backend(), "device_kind": kind,
         "preset": args.preset, "image": image,

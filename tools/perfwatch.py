@@ -1,0 +1,234 @@
+#!/usr/bin/env python3
+"""Perf-regression tracker — verdicts over the bench RESULT_JSON
+trajectory.
+
+The repo accumulates one bench artifact per round (``BENCH_r0N.json``
+at the root, written by the driver; ``docs/runs/bench_r*_tpu_v5e.json``
+archived by the battery after validating a live-TPU run). Whether a
+round's number is a win, noise, or a regression was judged by eyeball.
+This tool makes the judgment mechanical and consumable by ``doctor
+--perfwatch``:
+
+- parse every artifact (the ``parsed`` field when the driver captured
+  one, else salvage the last intact JSON line from the recorded stdout
+  ``tail`` — the BENCH_r04 failure mode, rc=124 with parsed=null);
+- extract the tracked metrics (headline CIFAR steps/sec, ImageNet
+  steps/sec and MFU) as (round, backend, value) samples;
+- cohort by backend — a CPU-fallback round must never be compared
+  against chip numbers (BENCH_r02/r03 recorded 0.03/0.01 st/s CPU
+  fallbacks while fetch-verified TPU numbers sat in docs/runs/);
+- compare the newest sample of the newest-sampled cohort against the
+  median of its predecessors with a configurable noise band.
+
+Verdicts per metric: ``regress`` (below band), ``improve`` (above),
+``flat`` (inside), ``insufficient_data`` (< 2 comparable samples).
+Exit code: 1 if ANY tracked metric regresses, else 0.
+
+    python tools/perfwatch.py [--root .] [--noise 0.08]
+        [--add runs/new_bench.json ...] [--json verdict.json]
+
+Stdlib-only and jax-free: runs anywhere the checkout does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Dict, List, Optional
+
+HEADLINE_METRIC = "cifar10_resnet50_train_steps_per_sec_b128"
+
+# (name, extractor) — every tracked metric is higher-is-better.
+def _headline(rec: dict) -> Optional[float]:
+    if rec.get("metric") == HEADLINE_METRIC:
+        return rec.get("value")
+    return None
+
+
+def _imagenet_sps(rec: dict) -> Optional[float]:
+    return (rec.get("imagenet") or {}).get("value")
+
+
+def _imagenet_mfu(rec: dict) -> Optional[float]:
+    return (rec.get("imagenet") or {}).get("mfu")
+
+
+METRICS = (
+    ("cifar_steps_per_sec", _headline),
+    ("imagenet_steps_per_sec", _imagenet_sps),
+    ("imagenet_mfu", _imagenet_mfu),
+)
+
+
+def salvage_result(text: str) -> Optional[dict]:
+    """Last intact JSON object line in a stdout tail — accepts both the
+    bare ``_emit`` line and child ``RESULT_JSON:``-prefixed snapshots,
+    skipping truncated lines (the BENCH_r04 capture truncated the only
+    emit mid-string; earlier complete lines, when present, still win)."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("RESULT_JSON: "):
+            line = line[len("RESULT_JSON: "):]
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and ("metric" in rec or "backend" in rec):
+            return rec
+    return None
+
+
+def _record_of(payload: dict) -> Optional[dict]:
+    """A driver round file ({parsed, tail, ...}) or a raw bench snapshot
+    → the bench result record."""
+    if "parsed" in payload or "tail" in payload:
+        rec = payload.get("parsed")
+        if not rec:
+            rec = salvage_result(payload.get("tail") or "")
+        return rec
+    return payload if isinstance(payload, dict) else None
+
+
+def load_samples(root: str, extra_files=()) -> List[dict]:
+    """Every (round, backend, metric, value) sample from the root's
+    ``BENCH_r*.json`` + archived ``docs/runs/bench_r*_tpu_v5e.json`` +
+    ``extra_files``. Samples are ordered oldest→newest: archived chip
+    artifacts sort by their round number alongside the driver rounds
+    (they are the same round's chip truth); extra files come last (they
+    are "the new run" perfwatch is asked to judge)."""
+    sources = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            sources.append((int(m.group(1)), 0, path))
+    for path in glob.glob(os.path.join(root, "docs", "runs",
+                                       "bench_r*_tpu_v5e.json")):
+        m = re.search(r"bench_r(\d+)_tpu_v5e\.json$", path)
+        if m:
+            # Archived chip artifacts supersede the driver capture of the
+            # same round (sort later within the round).
+            sources.append((int(m.group(1)), 1, path))
+    sources.sort()
+    order = [path for _, _, path in sources] + list(extra_files)
+
+    samples = []
+    for idx, path in enumerate(order):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            samples.append({"source": path, "error": f"{type(e).__name__}: "
+                                                     f"{e}"})
+            continue
+        rec = _record_of(payload)
+        if not rec:
+            samples.append({"source": path,
+                            "error": "no parseable RESULT_JSON"})
+            continue
+        backend = rec.get("backend") or "unknown"
+        for name, extract in METRICS:
+            try:
+                value = extract(rec)
+            except (TypeError, AttributeError):
+                value = None
+            if isinstance(value, (int, float)) and value > 0:
+                samples.append({"source": os.path.basename(path),
+                                "order": idx, "metric": name,
+                                "backend": backend, "value": float(value),
+                                "partial": bool(rec.get("partial"))})
+    return samples
+
+
+def judge(samples: List[dict], noise: float = 0.08) -> dict:
+    """Per-metric verdicts. For each metric the cohort is the backend of
+    its NEWEST sample; reference = median of the cohort's earlier
+    samples; the verdict compares latest/reference against the ±noise
+    band."""
+    verdict: Dict[str, dict] = {}
+    errors = [s for s in samples if "error" in s]
+    for name, _ in METRICS:
+        series = [s for s in samples if s.get("metric") == name]
+        if not series:
+            verdict[name] = {"verdict": "insufficient_data", "samples": 0}
+            continue
+        latest = series[-1]
+        cohort = [s for s in series if s["backend"] == latest["backend"]]
+        prior = [s["value"] for s in cohort[:-1]]
+        entry = {"backend": latest["backend"],
+                 "latest": latest["value"],
+                 "latest_source": latest["source"],
+                 "samples": len(cohort)}
+        if not prior:
+            entry["verdict"] = "insufficient_data"
+        else:
+            ref = statistics.median(prior)
+            ratio = latest["value"] / ref if ref else float("inf")
+            entry.update(reference=round(ref, 6), ratio=round(ratio, 4),
+                         noise_band=noise)
+            if ratio < 1.0 - noise:
+                entry["verdict"] = "regress"
+            elif ratio > 1.0 + noise:
+                entry["verdict"] = "improve"
+            else:
+                entry["verdict"] = "flat"
+        verdict[name] = entry
+    verdicts = {v["verdict"] for v in verdict.values()}
+    overall = ("regress" if "regress" in verdicts
+               else "improve" if "improve" in verdicts
+               else "flat" if "flat" in verdicts
+               else "insufficient_data")
+    return {"overall": overall, "noise": noise, "metrics": verdict,
+            "unparseable_sources": [e["source"] for e in errors]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="repo root holding BENCH_r*.json (default: this checkout)")
+    ap.add_argument("--noise", type=float, default=0.08,
+                    help="relative noise band; a latest/reference ratio "
+                         "inside 1±noise is 'flat' (default 0.08 — run-"
+                         "to-run swing measured on the rehearsal box)")
+    ap.add_argument("--add", action="append", default=[],
+                    help="extra result file(s) to judge as the newest "
+                         "run (bench emit JSON or driver round file); "
+                         "repeatable")
+    ap.add_argument("--json", default="",
+                    help="also write the verdict JSON to this path")
+    args = ap.parse_args(argv)
+
+    samples = load_samples(args.root, extra_files=args.add)
+    verdict = judge(samples, noise=args.noise)
+
+    for name, entry in verdict["metrics"].items():
+        line = f"[perfwatch] {name:24s} {entry['verdict']:18s}"
+        if "ratio" in entry:
+            line += (f" latest={entry['latest']:g} "
+                     f"ref={entry['reference']:g} "
+                     f"ratio={entry['ratio']:g} "
+                     f"({entry['backend']}, n={entry['samples']})")
+        elif "latest" in entry:
+            line += (f" latest={entry['latest']:g} "
+                     f"({entry['backend']}, n={entry['samples']})")
+        print(line)
+    print(f"[perfwatch] overall: {verdict['overall']} "
+          f"(noise band ±{args.noise:.0%})")
+    print("PERFWATCH_JSON: " + json.dumps(verdict))
+    if args.json:
+        tmp = args.json + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(verdict, f, indent=1)
+        os.replace(tmp, args.json)
+    return 1 if verdict["overall"] == "regress" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,28 @@ log = logging.getLogger("tpu_resnet.supervise")
 DEFAULT_PREEMPT_CODE = 42
 
 
+def _run_id_of(cmd) -> str:
+    """Best-effort run_id of the supervised trainer: find the
+    ``train.train_dir=...`` override in ``cmd`` and read the run_id.json
+    the trainer minted there (obs/manifest.py). Stdlib-only; '' when
+    unknown. Logged with every restart so a supervisor log line can be
+    joined to the run's trace-export timeline."""
+    import json
+    import os
+
+    train_dir = None
+    for arg in cmd:
+        if isinstance(arg, str) and arg.startswith("train.train_dir="):
+            train_dir = arg.split("=", 1)[1]
+    if not train_dir:
+        return ""
+    try:
+        with open(os.path.join(train_dir, "run_id.json")) as f:
+            return str(json.load(f).get("run_id") or "")
+    except (OSError, ValueError):
+        return ""
+
+
 def supervise(cmd, max_restarts: int = 100, preempt_code: int =
               DEFAULT_PREEMPT_CODE, backoff_base: float = 1.0,
               backoff_cap: float = 300.0, preempt_delay: float = 1.0,
@@ -52,6 +74,9 @@ def supervise(cmd, max_restarts: int = 100, preempt_code: int =
     crash_streak = 0
     while True:
         rc = run(cmd)
+        run_id = _run_id_of(cmd)
+        if run_id:
+            log.info("supervised run_id=%s exited %d", run_id, rc)
         if rc == 0:
             log.info("command exited 0 after %d restart(s)", restarts)
             return 0

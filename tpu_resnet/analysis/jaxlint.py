@@ -84,6 +84,13 @@ HOST_SYNC_PREFIXES = {
 HOST_SYNC_METHODS = {
     "item": "device sync per call",
     "block_until_ready": "device sync",
+    # Compile introspection (obs/mfu.py accounting): .lower()/.compile()
+    # .cost_analysis() re-traces and runs an HLO analysis pass — a
+    # one-time host-side startup cost that must never land in the jitted
+    # hot path (cost_analysis is the unambiguous marker; .lower/.compile
+    # collide with str.lower/re.compile and are left to review).
+    "cost_analysis": "XLA compile introspection (obs/mfu accounting) — "
+                     "host-side only, once per program, never per step",
 }
 
 SIGNAL_DENY_PREFIXES = ("subprocess.", "jax.", "jax_", "numpy.",

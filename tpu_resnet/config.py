@@ -276,6 +276,13 @@ class TrainConfig:
     # /healthz reports ok=false (HTTP 503) when the last heartbeat is
     # older than this many seconds.
     telemetry_stale_sec: float = 300.0
+    # MFU accounting (tpu_resnet/obs/mfu.py): measure the train step's
+    # per-step FLOPs once at first dispatch (abstract re-trace + HLO cost
+    # analysis — no second XLA compile) and publish live
+    # model_flops_per_sec / mfu gauges plus <train_dir>/flops.json.
+    # Purely host-side: does not change the compiled program (no new
+    # config-matrix rows needed).
+    mfu_accounting: bool = True
 
 
 @dataclasses.dataclass

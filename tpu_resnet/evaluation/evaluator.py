@@ -140,8 +140,16 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
     metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())
     # Eval-pass spans on the sidecar's own timeline file (the trainer owns
     # <train_dir>/events.jsonl; the evaluator may be a separate process).
+    # The train run's run_id is stamped on every span so trace-export can
+    # correlate the sidecar lane with the trainer it is polling; a
+    # sidecar started before the trainer re-reads it on first restore.
     from tpu_resnet import obs
-    spans = obs.SpanTracer(eval_dir, enabled=parallel.is_primary())
+    run_id = obs.read_run_id(cfg.train.train_dir)
+    spans = obs.SpanTracer(eval_dir, enabled=parallel.is_primary(),
+                           run_id=run_id)
+    if run_id:
+        log.info("eval sidecar polling %s (train run_id=%s)",
+                 cfg.train.train_dir, run_id)
     best_file = os.path.join(eval_dir, "best_precision.json")
     best = 0.0
     if os.path.exists(best_file):  # survive evaluator restarts (README.md:33)
@@ -173,6 +181,14 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
                     break
                 continue
             if step != last_seen:
+                if spans.run_id is None:
+                    # Trainer started after us: pick up its run_id now so
+                    # the remaining spans correlate.
+                    spans.run_id = run_id = obs.read_run_id(
+                        cfg.train.train_dir)
+                    if run_id:
+                        log.info("eval sidecar now polling train "
+                                 "run_id=%s", run_id)
                 state = restore_with_retry(
                     ckpt, template, step,
                     retries=cfg.resilience.eval_restore_retries,

@@ -33,6 +33,11 @@ def main(argv=None):
         # path must not import jax until it decides to.
         from tpu_resnet.analysis.cli import main as check_main
         return check_main(raw[1:])
+    if raw[:1] == ["trace-export"]:
+        # Same delegation: stdlib-only timeline export (obs/trace.py) —
+        # never imports jax, works on a machine with no backend.
+        from tpu_resnet.obs.trace import main as trace_main
+        return trace_main(raw[1:])
     parser = argparse.ArgumentParser(prog="tpu_resnet")
     sub = parser.add_subparsers(dest="command", required=True)
     for name, help_text in [
@@ -46,6 +51,9 @@ def main(argv=None):
                   "with checkpoint hot-reload (docs/SERVING.md)"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
+        ("trace-export", "merge a run's spans/metrics/eval/serve events "
+                         "into one Chrome-trace JSON (open in "
+                         "ui.perfetto.dev; docs/OBSERVABILITY.md)"),
         ("fetch", "download + verify + extract a dataset (cifar10/cifar100)"),
         ("doctor", "environment triage: backend probe, CPU mesh smoke, "
                    "native plane, dataset layout, run telemetry"),
@@ -53,7 +61,8 @@ def main(argv=None):
                   "abstract verifier (docs/CHECKS.md)"),
     ]:
         p = sub.add_parser(name, help=help_text)
-        if name not in ("fetch", "doctor", "check"):  # no run config
+        if name not in ("fetch", "doctor", "check",
+                        "trace-export"):  # no run config
             p.add_argument("--preset", default="")
             p.add_argument("--config", default="")
             p.add_argument("overrides", nargs="*")
@@ -119,6 +128,16 @@ def main(argv=None):
                                 "processes + implied max steps/sec — "
                                 "tells host-bound from chip-bound "
                                 "without a full bench run")
+            p.add_argument("--trace-probe", action="store_true",
+                           help="live observability drill (~60s tiny CPU "
+                                "run): scrape the live mfu gauge + "
+                                "train_step_ms histogram mid-run, then "
+                                "trace-export and schema-check the "
+                                "merged Chrome trace")
+            p.add_argument("--perfwatch", action="store_true",
+                           help="perf-regression verdict over the "
+                                "archived BENCH_*.json trajectory "
+                                "(tools/perfwatch.py)")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -137,7 +156,9 @@ def main(argv=None):
                              fault_drill=args.fault_drill,
                              data_bench=args.data_bench,
                              check=args.check,
-                             serve_probe=args.serve_probe)
+                             serve_probe=args.serve_probe,
+                             trace_probe=args.trace_probe,
+                             perfwatch=args.perfwatch)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

@@ -21,8 +21,17 @@ package provides them as first-class artifacts of every run:
                 written once at startup by the primary process.
 ``server``      a stdlib-only HTTP telemetry server per host exposing
                 ``/healthz`` (liveness + heartbeat age) and ``/metrics``
-                (Prometheus text) so pods can be scraped and stragglers
-                spotted without log-grepping.
+                (Prometheus text: gauges + fixed-bucket histograms) so
+                pods can be scraped and stragglers spotted without
+                log-grepping.
+``mfu``         first-class FLOPs/MFU accounting: per-device-kind peak
+                table, per-compiled-program FLOPs registry (keyed like
+                the golden-jaxpr entries), live ``model_flops_per_sec``
+                / ``mfu`` gauges.
+``trace``       ``tpu_resnet trace-export`` — merge spans, breakdown
+                samples, data-engine counters, eval and serve events
+                into one Chrome-trace/Perfetto JSON correlated by the
+                run's ``run_id``.
 
 Importing this package stays jax-free (jax is imported lazily where a
 device sync is needed) so stdlib-only consumers — ``tools/obs_scrape.py``,
@@ -30,11 +39,20 @@ the doctor's telemetry check — can use the scrape/parse helpers without
 pulling in a backend.
 """
 
+from tpu_resnet.obs import mfu
 from tpu_resnet.obs.breakdown import StepBreakdown
-from tpu_resnet.obs.manifest import build_manifest, write_manifest
+from tpu_resnet.obs.manifest import (
+    build_manifest,
+    ensure_run_id,
+    read_run_id,
+    write_manifest,
+)
 from tpu_resnet.obs.server import (
+    Histogram,
     TelemetryRegistry,
     TelemetryServer,
+    histogram_quantile,
+    parse_histograms,
     parse_prometheus,
     read_telemetry_port,
     scrape,
@@ -42,12 +60,18 @@ from tpu_resnet.obs.server import (
 from tpu_resnet.obs.spans import SpanTracer
 
 __all__ = [
+    "Histogram",
     "StepBreakdown",
     "SpanTracer",
     "TelemetryRegistry",
     "TelemetryServer",
     "build_manifest",
+    "ensure_run_id",
+    "histogram_quantile",
+    "mfu",
+    "parse_histograms",
     "parse_prometheus",
+    "read_run_id",
     "read_telemetry_port",
     "scrape",
     "write_manifest",

@@ -23,9 +23,51 @@ import socket
 import subprocess
 import sys
 import time
+import uuid
 from typing import Optional
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
+RUN_ID_FILE = "run_id.json"
+
+
+def ensure_run_id(train_dir: str, create: bool = True) -> Optional[str]:
+    """The run's correlation id — one short hex token shared by every
+    process that touches this train_dir (trainer, eval sidecar, serve,
+    loadgen, supervise) so their artifacts can be laid on one timeline
+    (obs/trace.py) and joined in logs.
+
+    Persisted in ``<train_dir>/run_id.json`` and REUSED across resumes:
+    a preempt/resume cycle is one run on one timeline, not three. With
+    ``create=False`` (read-only consumers: eval sidecar, serve, tools)
+    a missing file returns None instead of minting an id the trainer
+    doesn't know about."""
+    path = os.path.join(train_dir, RUN_ID_FILE)
+    try:
+        with open(path) as f:
+            rid = json.load(f).get("run_id")
+            if rid:
+                return str(rid)
+    except (OSError, ValueError):
+        pass
+    if not create:
+        return None
+    rid = uuid.uuid4().hex[:12]
+    try:
+        os.makedirs(train_dir, exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"run_id": rid, "created_at": time.time(),
+                       "hostname": socket.gethostname()}, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # correlation id is best-effort; the run must not die for it
+    return rid
+
+
+def read_run_id(train_dir: str) -> Optional[str]:
+    """Read-only run_id lookup (sidecars/tools); None when the trainer
+    hasn't created one."""
+    return ensure_run_id(train_dir, create=False)
 
 
 def _git_rev() -> Optional[str]:
@@ -43,7 +85,7 @@ def _git_rev() -> Optional[str]:
     return rev if proc.returncode == 0 and rev else None
 
 
-def build_manifest(cfg, mesh) -> dict:
+def build_manifest(cfg, mesh, run_id: Optional[str] = None) -> dict:
     """Assemble the manifest dict (pure; no filesystem writes)."""
     import jax
 
@@ -52,6 +94,7 @@ def build_manifest(cfg, mesh) -> dict:
     devices = list(mesh.devices.flat)
     return {
         "schema": SCHEMA_VERSION,
+        "run_id": run_id,
         "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "config": cfg.to_dict(),
         "mesh": {"shape": dict(mesh.shape),
@@ -74,7 +117,8 @@ def build_manifest(cfg, mesh) -> dict:
     }
 
 
-def write_manifest(train_dir: str, cfg, mesh) -> Optional[str]:
+def write_manifest(train_dir: str, cfg, mesh,
+                   run_id: Optional[str] = None) -> Optional[str]:
     """Write ``<train_dir>/manifest.json`` (primary process only; atomic).
     Returns the path, or None on a non-primary process."""
     from tpu_resnet import parallel
@@ -85,6 +129,7 @@ def write_manifest(train_dir: str, cfg, mesh) -> Optional[str]:
     path = os.path.join(train_dir, "manifest.json")
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:
-        json.dump(build_manifest(cfg, mesh), f, indent=1, default=list)
+        json.dump(build_manifest(cfg, mesh, run_id=run_id), f, indent=1,
+                  default=list)
     os.replace(tmp, path)
     return path

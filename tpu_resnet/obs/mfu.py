@@ -1,0 +1,256 @@
+"""MFU accounting — first-class FLOPs/utilization bookkeeping.
+
+The MLPerf TPU-pod scaling report (arXiv:1909.09756) and the pjit TPUv4
+training report (arXiv:2204.06514) both drive optimization campaigns off
+hardware-utilization accounting, not throughput alone: a steps/sec win
+that came from doing less math is not a win. Before this module the
+repo's FLOPs math lived ad hoc in two places (bench.py's imagenet entry
+and tools/mfu_probe.py) and a *running job* never knew its own MFU. Now:
+
+``PEAK_FLOPS_BY_KIND``  per-device-kind peak dense bf16 FLOP/s (public
+                        chip specs), the one table bench/probe/loop share.
+``program_flops``       FLOPs of a compiled/lowered XLA program from its
+                        cost analysis (handles the list/dict API forms).
+``FlopsRegistry``       per-compiled-program FLOPs registry, keyed like
+                        the golden-jaxpr entries of the config-matrix
+                        verifier (``train|cifar10_rn50_bf16|mesh1x1|b128``)
+                        so a FLOPs number is attributable to exactly one
+                        certified program shape. Persisted to
+                        ``<train_dir>/flops.json`` for tools.
+``mfu``                 model FLOPs utilization: achieved model FLOP/s
+                        over the mesh's aggregate peak.
+
+Cost analysis runs on the *lowered* (pre-optimization) module via
+``jit_fn.lower(...)`` — no second XLA compile, and the pre-fusion count
+is the model-FLOPs definition MFU wants (XLA-added recompute, e.g.
+remat, is utilization it would be cheating to claim). The lint suite
+enforces that these host-side introspection calls never appear in jit
+scope (docs/CHECKS.md, rule jit-host-sync): accounting happens once at
+compile time, gauges are pure host arithmetic at log boundaries.
+
+Module import stays jax-free (jax appears only inside functions) so
+stdlib-only consumers (bench.py's parent process, perfwatch) can use the
+peak table and registry file reader without a backend.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+log = logging.getLogger("tpu_resnet")
+
+REGISTRY_FILE = "flops.json"
+
+# Peak dense bf16 FLOP/s per chip by device_kind substring (public
+# specs). Order matters: more specific names first. The single source the
+# bench harness, tools/mfu_probe.py and the live mfu gauge all read.
+PEAK_FLOPS_BY_KIND = (
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v4", 275e12),
+)
+
+
+def peak_flops_per_chip(device_kind: str,
+                        env_var: str = "TPU_RESNET_PEAK_FLOPS"
+                        ) -> Optional[float]:
+    """Peak dense FLOP/s for one chip of ``device_kind``; None when the
+    kind is unknown (CPU, new silicon). ``env_var`` (and the bench
+    harness's historical ``BENCH_PEAK_FLOPS``) overrides the table —
+    the escape hatch for chips the table hasn't learned yet."""
+    for var in (env_var, "BENCH_PEAK_FLOPS"):
+        env = os.environ.get(var)
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                log.warning("ignoring non-numeric %s=%r", var, env)
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return None
+
+
+def program_flops(cost) -> Optional[float]:
+    """FLOPs from an XLA cost analysis — ``lowered.cost_analysis()`` or
+    ``compiled.cost_analysis()`` (older jax returns a one-element list).
+    None when the backend doesn't report them (some PJRT plugins)."""
+    try:
+        if isinstance(cost, list):
+            cost = cost[0] if cost else None
+        flops = (cost or {}).get("flops")
+        if flops and flops > 0:
+            return float(flops)
+    except Exception:  # noqa: BLE001 - accounting must never crash a run
+        pass
+    return None
+
+
+def lowered_flops(jit_fn, *args) -> Optional[float]:
+    """FLOPs of ``jit_fn``'s program for ``args`` via AOT lowering (no
+    XLA compile — tracing + HLO cost analysis only). ``args`` may mix
+    concrete arrays and ``jax.ShapeDtypeStruct`` avals. The count covers
+    the module as written (pre-SPMD-partitioning): for an auto-sharded
+    jit program that is the GLOBAL per-step FLOPs."""
+    try:
+        return program_flops(jit_fn.lower(*args).cost_analysis())
+    except Exception as e:  # noqa: BLE001 - never sink the caller
+        log.debug("lowered cost analysis unavailable: %s", e)
+        return None
+
+
+def analytic_resnet50_flops(batch: int, image: int = 224) -> float:
+    """Analytic fallback: ResNet-50 forward ≈ 4.09 GFLOPs per 224² image
+    (He et al.); training ≈ 3× forward (fwd + 2×bwd). Scaled by pixel
+    area for other resolutions. GLOBAL per-step FLOPs for ``batch``."""
+    return 3 * 4.09e9 * batch * (image / 224.0) ** 2
+
+
+def mfu(model_flops_per_sec: Optional[float], device_kind: str,
+        n_chips: int) -> Optional[float]:
+    """Model FLOPs utilization: achieved model FLOP/s over the aggregate
+    peak of ``n_chips`` chips of ``device_kind``. None when either side
+    is unknown — an unknown chip reports no number rather than a wrong
+    one."""
+    peak = peak_flops_per_chip(device_kind)
+    if not peak or not model_flops_per_sec or n_chips < 1:
+        return None
+    return model_flops_per_sec / (peak * n_chips)
+
+
+def train_program_key(cfg, mesh_shape: Dict[str, int],
+                      kind: str = "train") -> str:
+    """Registry key for the compiled program of ``cfg`` on a mesh —
+    spelled like the config-matrix golden-jaxpr entry names
+    (``cifar10_rn50_bf16`` …, analysis/configmatrix.py) extended with the
+    mesh and batch the FLOPs were counted at:
+
+        train|cifar10_rn50_bf16|mesh1x1|b128
+
+    ``data.engine`` is deliberately NOT part of the key: thread and
+    process engines feed byte-identical programs (the engine-invariance
+    twins the verifier pins), so their FLOPs must be one entry.
+    """
+    m = cfg.model
+    name = m.name if m.name != "resnet" else f"rn{m.resnet_size}"
+    if m.name == "resnet" and m.width_multiplier != 1:
+        name = f"wrn{m.resnet_size}_{m.width_multiplier}"
+    dtype = {"bfloat16": "bf16", "float32": "f32"}.get(
+        m.compute_dtype, m.compute_dtype)
+    variant = ("_fused" if m.fused_blocks else "") + \
+              ("_remat" if m.remat else "")
+    return (f"{kind}|{cfg.data.dataset}_{name}_{dtype}{variant}"
+            f"|mesh{mesh_shape.get('data', 1)}x{mesh_shape.get('model', 1)}"
+            f"|b{cfg.train.global_batch_size}")
+
+
+class FlopsRegistry:
+    """Per-compiled-program FLOPs entries, persisted per run.
+
+    One entry per program key: global per-step FLOPs, the source of the
+    number (xla_cost_analysis | analytic | none), bytes accessed when
+    known. The registry file (``<train_dir>/flops.json``) is what
+    trace-export, perfwatch and operators read back."""
+
+    def __init__(self):
+        self._entries: Dict[str, dict] = {}
+
+    def register(self, key: str, flops_per_step: Optional[float],
+                 source: str = "xla_cost_analysis", **extra) -> dict:
+        entry = {"flops_per_step": flops_per_step,
+                 "flops_source": source if flops_per_step else "none"}
+        entry.update(extra)
+        self._entries[key] = entry
+        return entry
+
+    def get(self, key: str) -> Optional[dict]:
+        return self._entries.get(key)
+
+    def flops(self, key: str) -> Optional[float]:
+        entry = self._entries.get(key) or {}
+        return entry.get("flops_per_step")
+
+    def to_dict(self) -> dict:
+        return {"format": 1, "entries": dict(self._entries)}
+
+    def save(self, train_dir: str) -> Optional[str]:
+        """Atomic ``<train_dir>/flops.json`` (tmp + rename, like every
+        other run artifact)."""
+        try:
+            os.makedirs(train_dir, exist_ok=True)
+            path = os.path.join(train_dir, REGISTRY_FILE)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.to_dict(), f, indent=1)
+            os.replace(tmp, path)
+            return path
+        except OSError as e:
+            log.warning("could not write %s: %s", REGISTRY_FILE, e)
+            return None
+
+    @classmethod
+    def load(cls, train_dir: str) -> "FlopsRegistry":
+        reg = cls()
+        try:
+            with open(os.path.join(train_dir, REGISTRY_FILE)) as f:
+                payload = json.load(f)
+            reg._entries.update(payload.get("entries", {}))
+        except (OSError, ValueError):
+            pass
+        return reg
+
+
+def account_train_step(cfg, mesh, state, base_step,
+                       per_replica_bn: bool = False,
+                       registry: Optional[FlopsRegistry] = None,
+                       train_dir: Optional[str] = None) -> dict:
+    """Measure and register the train step's per-step FLOPs for ``cfg``
+    on ``mesh``. Called ONCE per run right after the first dispatch
+    (compile already paid; this adds one abstract trace + HLO cost pass,
+    never a second XLA compile). Returns the registry entry.
+
+    The probe lowers the plain sharded single step over abstract batch
+    avals — the same program every input path (resident chunks, staged
+    superbatches, streaming) runs per step, so one entry covers all
+    three dispatch shapes."""
+    import jax
+
+    from tpu_resnet import parallel
+    from tpu_resnet.train.step import shard_step
+
+    registry = registry or FlopsRegistry()
+    key = train_program_key(cfg, dict(mesh.shape))
+    bs = parallel.batch_sharding(mesh)
+    size = cfg.data.resolved_image_size
+    gb = cfg.train.global_batch_size
+    # ImageNet streams pre-processed floats; every other dataset feeds
+    # raw uint8 and augments on device — match what the step compiles on.
+    img_dtype = "float32" if cfg.data.dataset == "imagenet" else "uint8"
+    images = jax.ShapeDtypeStruct((gb, size, size, 3), img_dtype,
+                                  sharding=bs)
+    labels = jax.ShapeDtypeStruct((gb,), "int32", sharding=bs)
+    probe = shard_step(base_step, mesh, donate_state=False,
+                       per_replica_bn=per_replica_bn)
+    flops = lowered_flops(probe, state, images, labels)
+    source = "xla_cost_analysis"
+    if flops is None and cfg.model.name == "resnet" \
+            and cfg.data.dataset == "imagenet":
+        flops, source = analytic_resnet50_flops(gb, size), "analytic"
+    elif flops is not None and per_replica_bn:
+        # The shard_map body is lowered per-shard: scale the local count
+        # back to the global batch so the entry means the same thing on
+        # every mesh shape.
+        flops *= mesh.shape["data"]
+    kind = mesh.devices.flat[0].device_kind
+    entry = registry.register(
+        key, flops, source=source, global_batch=gb,
+        device_kind=kind, n_devices=int(mesh.size),
+        peak_flops_per_chip=peak_flops_per_chip(kind))
+    if train_dir:
+        registry.save(train_dir)
+    return entry

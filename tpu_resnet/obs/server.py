@@ -25,8 +25,10 @@ discover it. This module imports no jax — stdlib-only consumers can use
 
 from __future__ import annotations
 
+import bisect
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -59,6 +61,13 @@ CORE_GAUGES = (
      "Host decode throughput over the last interval"),
     ("compile_seconds", "First-dispatch wall time (trace+compile+run)"),
     ("checkpoint_lag_steps", "Steps since the last checkpoint save"),
+    # MFU accounting (tpu_resnet/obs/mfu.py): achieved model FLOP/s and
+    # utilization vs the chip peak — the numbers the MFU campaign's
+    # per-knob wins must show up in (ROADMAP item 3). 0 until the first
+    # log boundary; mfu stays 0 on chips the peak table doesn't know.
+    ("model_flops_per_sec", "Achieved model FLOP/s over the last "
+                            "interval (global, all chips)"),
+    ("mfu", "Model FLOPs utilization vs aggregate peak (0..1)"),
     # Fault counters (tpu_resnet/resilience) — pre-declared so a scrape on
     # a healthy run reports explicit zeros, not absent series.
     ("fault_nan_rollbacks", "NaN/divergence rollbacks performed"),
@@ -91,29 +100,156 @@ SERVE_GAUGES = (
 )
 
 
+# Histogram bucket edges (upper bounds; +Inf is implicit). Latencies in
+# ms span sub-ms CPU inference to multi-second stragglers; the fraction
+# scale covers 0..1 ratios (pad fraction).
+LATENCY_BUCKETS_MS = (1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                      500.0, 1000.0, 2000.0, 5000.0)
+FRACTION_BUCKETS = (0.01, 0.02, 0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.75, 1.0)
+
+# Pre-declared histogram series, same convention as the gauges: a scrape
+# taken before the first observation sees empty buckets, not absent
+# series. (name, help, bucket edges).
+CORE_HISTOGRAMS = (
+    ("train_step_ms", "Per-step wall time, observed once per step at "
+                      "each log boundary", LATENCY_BUCKETS_MS),
+)
+SERVE_HISTOGRAMS = (
+    ("serve_latency_ms", "End-to-end predict latency (enqueue to "
+                         "result)", LATENCY_BUCKETS_MS),
+    ("serve_queue_wait_ms", "Time a request waited in the queue before "
+                            "its batch was formed", LATENCY_BUCKETS_MS),
+    ("serve_pad_fraction", "Padded fraction of each dispatched bucket "
+                           "(compile-avoidance cost per batch)",
+     FRACTION_BUCKETS),
+)
+
+
 def _sanitize(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus exposition semantics.
+
+    ``observe(v, n)`` adds ``n`` observations of value ``v`` (n>1 is the
+    weighted form the train loop uses: one interval = ``steps``
+    observations of the interval's mean step time). Rendering follows
+    the Prometheus histogram convention exactly — cumulative
+    ``_bucket{le="..."}`` counts, ``_sum`` and ``_count`` — so a stock
+    Prometheus server can do ``histogram_quantile()`` over scrapes while
+    :func:`histogram_quantile` here gives the same answer offline.
+
+    Not thread-safe by itself; TelemetryRegistry serializes access under
+    its lock."""
+
+    __slots__ = ("name", "help", "edges", "counts", "total", "sum")
+
+    def __init__(self, name: str, help: str = "", edges=LATENCY_BUCKETS_MS):
+        edges = tuple(float(e) for e in edges)
+        if not edges or list(edges) != sorted(set(edges)):
+            raise ValueError(f"bucket edges must be strictly increasing, "
+                             f"got {edges}")
+        self.name = _sanitize(name)
+        self.help = help
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)  # last = overflow (+Inf)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value, n: int = 1) -> None:
+        try:
+            value = float(value)
+            n = int(n)
+        except (TypeError, ValueError):
+            return
+        if n < 1:
+            return
+        i = bisect.bisect_left(self.edges, value)
+        self.counts[i] += n
+        self.total += n
+        self.sum += value * n
+
+    def snapshot(self) -> dict:
+        """``{"buckets": [(le, cumulative_count)...], "sum", "count"}``
+        with the trailing +Inf bucket — the same structure
+        :func:`parse_histograms` reconstructs from a scrape."""
+        cum, buckets = 0, []
+        for edge, c in zip(self.edges, self.counts):
+            cum += c
+            buckets.append((edge, cum))
+        buckets.append((math.inf, self.total))
+        return {"buckets": buckets, "sum": self.sum, "count": self.total}
+
+    def percentile(self, q: float) -> float:
+        return histogram_quantile(self.snapshot(), q)
+
+    def render(self, namespace: str = NAMESPACE) -> list:
+        full = f"{namespace}_{self.name}"
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {full} {self.help}")
+        lines.append(f"# TYPE {full} histogram")
+        cum = 0
+        for edge, c in zip(self.edges, self.counts):
+            cum += c
+            lines.append(f'{full}_bucket{{le="{edge!r}"}} {cum}')
+        lines.append(f'{full}_bucket{{le="+Inf"}} {self.total}')
+        lines.append(f"{full}_sum {self.sum!r}")
+        lines.append(f"{full}_count {self.total}")
+        return lines
+
+
+def histogram_quantile(hist: dict, q: float) -> float:
+    """Quantile from a histogram snapshot (``Histogram.snapshot()`` or a
+    :func:`parse_histograms` entry): linear interpolation inside the
+    bucket containing the target rank — the same estimator Prometheus's
+    ``histogram_quantile()`` uses, so live dashboards and offline tools
+    agree. Returns 0.0 for an empty histogram; the overflow bucket
+    reports its lower edge (the largest finite edge)."""
+    buckets = hist.get("buckets") or []
+    total = hist.get("count", 0)
+    if not buckets or total <= 0:
+        return 0.0
+    q = min(max(q, 0.0), 1.0)
+    rank = q * total
+    prev_edge, prev_cum = 0.0, 0
+    for edge, cum in buckets:
+        if cum >= rank:
+            if math.isinf(edge):
+                return float(prev_edge)
+            if cum == prev_cum:
+                return float(edge)
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return float(prev_edge + (edge - prev_edge) * frac)
+        prev_edge, prev_cum = edge, cum
+    return float(prev_edge)
 
 
 class TelemetryRegistry:
     """Thread-safe gauge store shared by the training loop (writer) and
     the HTTP server threads (readers)."""
 
-    def __init__(self, stale_after_sec: float = 300.0, gauges=CORE_GAUGES):
-        """``gauges`` is the pre-declared series set — CORE_GAUGES for a
-        training process, SERVE_GAUGES for the predict server (scrapes
-        taken before the first batch must see explicit zeros, not absent
-        series)."""
+    def __init__(self, stale_after_sec: float = 300.0, gauges=CORE_GAUGES,
+                 histograms=()):
+        """``gauges``/``histograms`` are the pre-declared series sets —
+        CORE_* for a training process, SERVE_* for the predict server
+        (scrapes taken before the first batch must see explicit
+        zeros/empty buckets, not absent series)."""
         self.stale_after_sec = float(stale_after_sec)
         self._lock = threading.Lock()
         self._gauges: Dict[str, float] = {}
         self._help: Dict[str, str] = {}
+        self._hists: Dict[str, Histogram] = {}
         self._hb_wall: Optional[float] = None
         self._hb_step: Optional[int] = None
         self._unhealthy_reason: Optional[str] = None
         self._started = time.time()
         for name, help_text in gauges:
             self.set(name, 0.0, help=help_text)
+        for name, help_text, edges in histograms:
+            h = Histogram(name, help_text, edges)
+            self._hists[h.name] = h
 
     def set(self, name: str, value, help: str = "") -> None:
         try:
@@ -129,6 +265,26 @@ class TelemetryRegistry:
     def update(self, scalars: Dict[str, float]) -> None:
         for k, v in scalars.items():
             self.set(k, v)
+
+    def observe(self, name: str, value, n: int = 1) -> None:
+        """Add ``n`` observations of ``value`` to histogram ``name``
+        (created on first use with the default latency buckets if it was
+        not pre-declared)."""
+        name = _sanitize(name)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(name)
+            h.observe(value, n)
+
+    def hist_percentile(self, name: str, q: float) -> float:
+        """Quantile estimate over histogram ``name`` (0.0 when absent or
+        empty) — the host-side read the serve bucket retuning and the
+        loop's step-time percentile metrics use."""
+        with self._lock:
+            h = self._hists.get(_sanitize(name))
+            snap = h.snapshot() if h is not None else None
+        return histogram_quantile(snap, q) if snap else 0.0
 
     def heartbeat(self, step: int) -> None:
         """Mark the trainer alive at ``step`` (call at every log point)."""
@@ -171,10 +327,16 @@ class TelemetryRegistry:
         return out
 
     def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4 — gauges plus
+        histogram series (cumulative ``_bucket{le=...}``/``_sum``/
+        ``_count``, the standard exposition
+        :func:`parse_histograms` round-trips)."""
         with self._lock:
             gauges = dict(self._gauges)
             helps = dict(self._help)
+            hist_lines = []
+            for name in sorted(self._hists):
+                hist_lines.extend(self._hists[name].render())
         gauges["heartbeat_age_seconds"] = round(self.heartbeat_age(), 3)
         helps.setdefault("heartbeat_age_seconds",
                          "Seconds since the trainer's last heartbeat")
@@ -185,6 +347,7 @@ class TelemetryRegistry:
                 lines.append(f"# HELP {full} {helps[name]}")
             lines.append(f"# TYPE {full} gauge")
             lines.append(f"{full} {gauges[name]!r}")
+        lines.extend(hist_lines)
         return "\n".join(lines) + "\n"
 
 
@@ -323,20 +486,24 @@ def scrape(base_url: str, timeout: float = 5.0) -> dict:
         base_url = "http://" + base_url
     with urllib.request.urlopen(base_url + "/metrics",
                                 timeout=timeout) as resp:
-        metrics = parse_prometheus(resp.read().decode())
+        text = resp.read().decode()
+    metrics = parse_prometheus(text)
     try:
         with urllib.request.urlopen(base_url + "/healthz",
                                     timeout=timeout) as resp:
             status, body = resp.status, resp.read()
     except urllib.error.HTTPError as e:  # 503 stale: report, don't raise
         status, body = e.code, e.read()
-    return {"metrics": metrics, "health": json.loads(body.decode()),
+    return {"metrics": metrics, "histograms": parse_histograms(text),
+            "health": json.loads(body.decode()),
             "health_status": status}
 
 
 def parse_prometheus(text: str) -> Dict[str, float]:
     """Prometheus text → {metric_name: value}. Raises ValueError on a
-    malformed sample line (the scrape tests use this as the parser)."""
+    malformed sample line (the scrape tests use this as the parser).
+    Histogram component series collapse to their last sample here; use
+    :func:`parse_histograms` for the bucket structure."""
     out: Dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()
@@ -347,4 +514,62 @@ def parse_prometheus(text: str) -> Dict[str, float]:
             raise ValueError(f"malformed sample line: {line!r}")
         name = parts[0].split("{", 1)[0]
         out[name] = float(parts[1])
+    return out
+
+
+_LE_LABEL = re.compile(r'\{le="([^"]+)"\}')
+
+
+def parse_histograms(text: str) -> Dict[str, dict]:
+    """Prometheus text → histogram structures.
+
+    Collects ``name_bucket{le="..."}``/``name_sum``/``name_count``
+    triplets declared ``# TYPE name histogram`` into
+    ``{name: {"buckets": [(le, cum)...], "sum": s, "count": n}}`` — the
+    same snapshot shape :meth:`Histogram.snapshot` produces, so
+    :func:`histogram_quantile` works on live scrapes and in-process
+    histograms alike. Unparseable histogram lines are skipped (a gauge
+    parser strictness here would make every scraper crash on a
+    mid-write exposition)."""
+    declared = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE ") and line.rstrip().endswith(
+                " histogram"):
+            declared.add(line.split()[2])
+    out: Dict[str, dict] = {
+        name: {"buckets": [], "sum": 0.0, "count": 0} for name in declared}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) < 2:
+            continue
+        sample, value = parts[0], parts[1]
+        base = sample.split("{", 1)[0]
+        for name in declared:
+            if base == name + "_bucket":
+                m = _LE_LABEL.search(sample)
+                if not m:
+                    break
+                le = math.inf if m.group(1) == "+Inf" else float(m.group(1))
+                try:
+                    out[name]["buckets"].append((le, int(float(value))))
+                except ValueError:
+                    pass
+                break
+            if base == name + "_sum":
+                try:
+                    out[name]["sum"] = float(value)
+                except ValueError:
+                    pass
+                break
+            if base == name + "_count":
+                try:
+                    out[name]["count"] = int(float(value))
+                except ValueError:
+                    pass
+                break
+    for hist in out.values():
+        hist["buckets"].sort(key=lambda b: b[0])
     return out

@@ -31,8 +31,16 @@ log = logging.getLogger("tpu_resnet")
 
 class SpanTracer:
     def __init__(self, directory: str, enabled: bool = True,
-                 filename: str = "events.jsonl"):
+                 filename: str = "events.jsonl",
+                 run_id: str = None):
+        """``run_id`` (obs/manifest.py::ensure_run_id) is stamped on
+        every record — the correlation key obs/trace.py uses to lay
+        trainer/eval/serve files on one timeline. Mutable: a sidecar
+        that starts before the trainer minted the id can set
+        ``tracer.run_id`` once discovered."""
         self.enabled = enabled
+        self.run_id = run_id
+        self._pid = os.getpid()
         self._f = None
         if not enabled:
             return
@@ -44,7 +52,9 @@ class SpanTracer:
         if self._f is None:
             return
         rec = {"span": kind, "start": round(start, 6), "end": round(end, 6),
-               "duration_sec": round(end - start, 6)}
+               "duration_sec": round(end - start, 6), "pid": self._pid}
+        if self.run_id is not None:
+            rec["run_id"] = self.run_id
         rec.update(attrs)
         try:
             self._f.write(json.dumps(rec) + "\n")

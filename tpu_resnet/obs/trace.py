@@ -1,0 +1,327 @@
+"""Unified run timeline — merge every observability artifact of a
+train_dir into ONE Chrome-trace/Perfetto JSON.
+
+Before this module the run's timeline was four disconnected files
+(events.jsonl spans, metrics.jsonl breakdown samples, the eval sidecar's
+own events, the serve process's events) that could only be correlated by
+eyeballing wall-clock numbers. The exporter lays them on one timeline the
+way the TPU scaling reports drive their optimization campaigns
+(arXiv:2204.06514, arXiv:1909.09756 — profiler timelines, not throughput
+logs):
+
+    python -m tpu_resnet trace-export --dir /tmp/run1
+    # → /tmp/run1/trace.json ; open in https://ui.perfetto.dev or
+    #   chrome://tracing (no upload needed — Perfetto parses locally)
+
+Lanes (Chrome trace "processes"/"threads"):
+
+- **trainer** (pid from its spans): the run/compile/checkpoint/
+  nan_rollback/preempt spans from ``events.jsonl``, plus two counter
+  threads derived from ``metrics.jsonl`` — the step-time breakdown
+  (data_wait_frac, steps_per_sec, mfu, model_flops_per_sec) and the
+  data-engine ring (occupancy, decode rate). Logged intervals render as
+  ``train_interval`` slices carrying the full breakdown in args.
+- **eval sidecar** (``eval/events.jsonl``): eval_pass/restore spans. An
+  in-process sidecar (train_and_eval) shares the trainer's pid and shows
+  up as another thread of the same process — which is the truth.
+- **serve** (``serve_events.jsonl``): warmup, hot-reload, drain spans.
+
+Correlation key: the ``run_id`` every writer stamps (obs/manifest.py).
+The exporter records it in trace metadata and appends it to each lane's
+process name, so a screenshotless review can still assert "these lanes
+are one session". Mismatched run_ids are kept (they are evidence of a
+mixed directory) and reported under ``metadata.source_run_ids``.
+
+Stdlib-only, no jax: exports run on any machine that can read the files.
+Output is deterministic — same inputs, byte-identical trace — so
+re-exports diff clean and tests can pin stability.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from tpu_resnet.obs.spans import load_jsonl, load_spans
+
+SERVE_EVENTS_FILE = "serve_events.jsonl"
+TRACE_FILE = "trace.json"
+
+# Synthetic lane ids used when a source file predates pid stamping.
+_FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3}
+# Thread ids within a lane (Chrome traces key threads by (pid, tid)).
+_TID_SPANS = {"train": 1, "eval": 11, "serve": 21}
+_TID_BREAKDOWN = 2
+_TID_ENGINE = 3
+
+# Counter series lifted from metrics.jsonl records onto counter threads:
+# (record key, counter thread, counter name).
+_COUNTER_KEYS = (
+    ("steps_per_sec", _TID_BREAKDOWN, "steps_per_sec"),
+    ("data_wait_frac", _TID_BREAKDOWN, "data_wait_frac"),
+    ("model_flops_per_sec", _TID_BREAKDOWN, "model_flops_per_sec"),
+    ("mfu", _TID_BREAKDOWN, "mfu"),
+    ("data_ring_occupancy", _TID_ENGINE, "data_ring_occupancy"),
+    ("data_decode_images_per_sec", _TID_ENGINE,
+     "data_decode_images_per_sec"),
+)
+
+_INTERVAL_ARG_KEYS = (
+    "loss", "precision", "learning_rate", "steps_per_sec",
+    "images_per_sec", "data_wait_sec", "data_wait_frac", "dispatch_sec",
+    "device_sync_sec", "device_step_sec_sampled", "compile_seconds",
+    "model_flops_per_sec", "mfu", "train_step_ms_p50", "train_step_ms_p95",
+    "train_step_ms_p99", "data_ring_occupancy",
+    "data_decode_images_per_sec",
+)
+
+
+def _us(wall: float, base: float) -> float:
+    """Wall-clock seconds → trace microseconds relative to ``base``,
+    rounded so float formatting is stable across platforms."""
+    return round((wall - base) * 1e6, 1)
+
+
+def _span_events(spans: List[dict], source: str, base: float,
+                 pid_of: Dict[str, int]) -> List[dict]:
+    events = []
+    pid = pid_of[source]
+    tid = _TID_SPANS[source]
+    for s in spans:
+        try:
+            start, end = float(s["start"]), float(s["end"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if end < start:
+            continue
+        args = {k: v for k, v in s.items()
+                if k not in ("span", "start", "end", "pid")}
+        common = {"name": str(s.get("span", "span")), "cat": source,
+                  "pid": pid, "tid": tid, "ts": _us(start, base),
+                  "args": args}
+        if end == start:
+            events.append({**common, "ph": "i", "s": "t"})
+        else:
+            events.append({**common, "ph": "X",
+                           "dur": round((end - start) * 1e6, 1)})
+    return events
+
+
+def _metrics_events(records: List[dict], base: float, pid: int
+                    ) -> List[dict]:
+    """metrics.jsonl → counter samples + per-interval slices on the
+    trainer lane."""
+    events = []
+    prev = None
+    for rec in sorted(records, key=lambda r: r.get("wall", 0.0)):
+        wall = rec.get("wall")
+        if wall is None:
+            continue
+        ts = _us(wall, base)
+        for key, tid, name in _COUNTER_KEYS:
+            if key in rec:
+                events.append({"name": name, "ph": "C", "pid": pid,
+                               "tid": tid, "ts": ts,
+                               "args": {"value": rec[key]}})
+        if prev is not None and "data_wait_sec" in rec:
+            args = {k: rec[k] for k in _INTERVAL_ARG_KEYS if k in rec}
+            args["step"] = rec.get("step")
+            events.append({
+                "name": f"train_interval@{rec.get('step')}",
+                "cat": "train", "ph": "X", "pid": pid,
+                "tid": _TID_BREAKDOWN, "ts": _us(prev, base),
+                "dur": round((wall - prev) * 1e6, 1), "args": args})
+        prev = wall
+    return events
+
+
+def _meta(name: str, pid: int, tid: Optional[int] = None,
+          label: str = "") -> dict:
+    ev = {"name": name, "ph": "M", "pid": pid, "ts": 0.0,
+          "args": {"name": label}}
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def _source_pid(spans: List[dict], source: str) -> int:
+    for s in spans:
+        pid = s.get("pid")
+        if isinstance(pid, int):
+            return pid
+    return _FALLBACK_PID[source]
+
+
+def _run_ids(spans: List[dict]) -> List[str]:
+    return sorted({str(s["run_id"]) for s in spans if s.get("run_id")})
+
+
+def build_trace(train_dir: str) -> dict:
+    """Assemble the merged Chrome-trace dict (pure read; no writes)."""
+    sources: Dict[str, List[dict]] = {
+        "train": load_spans(os.path.join(train_dir, "events.jsonl")),
+        "eval": load_spans(os.path.join(train_dir, "eval",
+                                        "events.jsonl")),
+        "serve": load_spans(os.path.join(train_dir, SERVE_EVENTS_FILE)),
+    }
+    metrics = load_jsonl(os.path.join(train_dir, "metrics.jsonl"), "step")
+
+    manifest_run_id = None
+    try:
+        with open(os.path.join(train_dir, "manifest.json")) as f:
+            manifest_run_id = json.load(f).get("run_id")
+    except (OSError, ValueError):
+        pass
+    if manifest_run_id is None:
+        try:
+            with open(os.path.join(train_dir, "run_id.json")) as f:
+                manifest_run_id = json.load(f).get("run_id")
+        except (OSError, ValueError):
+            pass
+
+    walls = [float(s[k]) for spans in sources.values() for s in spans
+             for k in ("start", "end") if isinstance(s.get(k), (int, float))]
+    walls += [float(r["wall"]) for r in metrics
+              if isinstance(r.get("wall"), (int, float))]
+    if not walls:
+        raise FileNotFoundError(
+            f"no observability artifacts under {train_dir} — need "
+            "events.jsonl and/or metrics.jsonl (train with "
+            "train.telemetry-enabled defaults)")
+    base = min(walls)
+
+    pid_of = {src: _source_pid(spans, src)
+              for src, spans in sources.items()}
+    # Distinct sources that fell back to the same synthetic pid must not
+    # merge lanes; the real-pid collision (in-process eval sidecar) is a
+    # true shared process and keeps one lane on purpose.
+    events: List[dict] = []
+    source_run_ids = {src: _run_ids(spans)
+                      for src, spans in sources.items() if spans}
+    run_id = manifest_run_id or next(
+        (ids[0] for ids in source_run_ids.values() if ids), None)
+
+    labels = {"train": "trainer", "eval": "eval-sidecar", "serve": "serve"}
+    for src, spans in sources.items():
+        if not spans and not (src == "train" and metrics):
+            continue
+        pid = pid_of[src]
+        rid = (source_run_ids.get(src) or [run_id or ""])[0]
+        suffix = f" run={rid}" if rid else ""
+        events.append(_meta("process_name", pid,
+                            label=f"{labels[src]}{suffix}"))
+        events.append(_meta("thread_name", pid, _TID_SPANS[src],
+                            f"{labels[src]}-spans"))
+        events.extend(_span_events(spans, src, base, pid_of))
+    if metrics:
+        pid = pid_of["train"]
+        events.append(_meta("thread_name", pid, _TID_BREAKDOWN,
+                            "step-breakdown"))
+        if any("data_ring_occupancy" in r for r in metrics):
+            events.append(_meta("thread_name", pid, _TID_ENGINE,
+                                "data-engine"))
+        events.extend(_metrics_events(metrics, base, pid))
+
+    events.sort(key=lambda e: (e["ts"], e["pid"], e.get("tid", 0),
+                               e["ph"], e["name"]))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "tpu_resnet trace-export",
+            "train_dir": os.path.abspath(train_dir),
+            "run_id": run_id,
+            "source_run_ids": source_run_ids,
+            "base_time_unix": base,
+        },
+    }
+
+
+def validate_trace(trace: dict) -> List[str]:
+    """Chrome-trace schema check shared by the tests and
+    ``doctor --trace-probe``. Returns a list of problems (empty = valid):
+    required top-level keys, per-event required fields, known phases,
+    non-negative monotonically ordered ``ts``, non-negative ``dur``."""
+    problems: List[str] = []
+    if not isinstance(trace, dict):
+        return ["trace is not a JSON object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    if not events:
+        problems.append("traceEvents is empty")
+    known_ph = {"X", "i", "C", "M", "B", "E"}
+    last_ts = None
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        for key in ("name", "ph", "pid", "ts"):
+            if key not in ev:
+                problems.append(f"{where}: missing required key {key!r}")
+        ph = ev.get("ph")
+        if ph not in known_ph:
+            problems.append(f"{where}: unknown phase {ph!r}")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            problems.append(f"{where}: ts must be a non-negative number, "
+                            f"got {ts!r}")
+        elif last_ts is not None and ts < last_ts:
+            problems.append(f"{where}: ts {ts} < previous {last_ts} — "
+                            "events must be sorted")
+        else:
+            last_ts = ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: X event needs dur >= 0, "
+                                f"got {dur!r}")
+        if len(problems) > 50:
+            problems.append("... (truncated)")
+            break
+    return problems
+
+
+def export_trace(train_dir: str, out: Optional[str] = None
+                 ) -> Tuple[str, dict]:
+    """Build + write the merged trace. Deterministic output (atomic
+    tmp+rename, sorted keys) so a re-export over unchanged inputs is
+    byte-identical. Returns ``(path, trace)``."""
+    trace = build_trace(train_dir)
+    problems = validate_trace(trace)
+    if problems:  # exporting an invalid trace would hide the bug
+        raise ValueError("trace-export produced an invalid trace: "
+                         + "; ".join(problems[:5]))
+    out = out or os.path.join(train_dir, TRACE_FILE)
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(trace, f, indent=None, sort_keys=True,
+                  separators=(",", ":"))
+        f.write("\n")
+    os.replace(tmp, out)
+    return out, trace
+
+
+def main(argv=None) -> int:
+    """CLI: ``python -m tpu_resnet trace-export --dir D [--out F]``."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="trace-export",
+        description="merge a run's events/metrics/eval/serve artifacts "
+                    "into one Chrome-trace JSON (open in ui.perfetto.dev)")
+    ap.add_argument("--dir", required=True, help="train dir of the run")
+    ap.add_argument("--out", default="",
+                    help="output path (default <dir>/trace.json)")
+    args = ap.parse_args(argv)
+    try:
+        path, trace = export_trace(args.dir, out=args.out or None)
+    except (OSError, ValueError) as e:
+        print(f"trace-export failed: {e}")
+        return 1
+    n = len(trace["traceEvents"])
+    print(f"wrote {path} ({n} events, run_id={trace['metadata']['run_id']})")
+    return 0

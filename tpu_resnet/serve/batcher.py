@@ -124,9 +124,16 @@ class MicroBatcher:
                  max_queue: int = 256,
                  between_batches: Optional[Callable[[], None]] = None,
                  on_stats: Optional[Callable[[Dict], None]] = None,
+                 observe: Optional[Callable[[str, float], None]] = None,
                  latency_ring: int = 1024,
                  idle_tick_sec: float = 0.05):
+        """``observe(name, value)`` receives per-request/per-batch
+        distribution samples — ``latency_ms`` and ``queue_wait_ms`` per
+        request, ``pad_fraction`` per dispatched batch — which the
+        server feeds into its Prometheus histograms (obs/server.py).
+        Called from the worker thread; exceptions are swallowed."""
         self._infer = infer_fn
+        self._observe = observe
         self.image_shape = tuple(image_shape)
         self.buckets = tuple(sorted(set(buckets))) if buckets \
             else default_buckets(max_batch)
@@ -243,14 +250,26 @@ class MicroBatcher:
             total += nxt.n
         return reqs
 
+    def _observe_safe(self, name: str, value: float) -> None:
+        if self._observe is None:
+            return
+        try:
+            self._observe(name, value)
+        except Exception:  # noqa: BLE001 - telemetry must not kill serving
+            pass
+
     def _run_batch(self, reqs: List[PendingRequest]) -> None:
         total = sum(r.n for r in reqs)
         bucket = pick_bucket(total, self.buckets)
         batch = np.zeros((bucket,) + self.image_shape, np.uint8)
         off = 0
+        formed_at = time.monotonic()
         for r in reqs:
             batch[off:off + r.n] = r.images
             off += r.n
+            self._observe_safe("queue_wait_ms",
+                               (formed_at - r.enqueued_at) * 1e3)
+        self._observe_safe("pad_fraction", (bucket - total) / bucket)
         try:
             logits = np.asarray(self._infer(batch))
         except Exception as e:  # noqa: BLE001 - per-batch failure domain
@@ -264,6 +283,7 @@ class MicroBatcher:
         for r in reqs:
             r.set_result(logits[off:off + r.n])
             off += r.n
+            self._observe_safe("latency_ms", r.latency_ms)
         with self._lock:
             self._counters["batches"] += 1
             self._counters["batched_images"] += total

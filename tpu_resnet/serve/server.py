@@ -45,7 +45,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 from tpu_resnet.config import RunConfig
-from tpu_resnet.obs.server import SERVE_GAUGES, TelemetryRegistry
+from tpu_resnet.obs.manifest import read_run_id
+from tpu_resnet.obs.server import (SERVE_GAUGES, SERVE_HISTOGRAMS,
+                                   TelemetryRegistry)
+from tpu_resnet.obs.spans import SpanTracer
 from tpu_resnet.serve.batcher import (Draining, MicroBatcher, QueueFull,
                                       default_buckets)
 
@@ -108,7 +111,8 @@ class PredictServer:
     (tests) or via :func:`serve` (CLI)."""
 
     def __init__(self, cfg: RunConfig, backend=None,
-                 registry: Optional[TelemetryRegistry] = None):
+                 registry: Optional[TelemetryRegistry] = None,
+                 spans: Optional[SpanTracer] = None):
         from tpu_resnet.serve.backend import build_backend
 
         self.cfg = cfg
@@ -123,7 +127,13 @@ class PredictServer:
         self.registry = registry if registry is not None \
             else TelemetryRegistry(
                 stale_after_sec=cfg.train.telemetry_stale_sec,
-                gauges=SERVE_GAUGES)
+                gauges=SERVE_GAUGES, histograms=SERVE_HISTOGRAMS)
+        # Serve-side timeline (serve_events.jsonl) + correlation id: the
+        # run_id of the train_dir being served, stamped on spans and
+        # echoed in /info so loadgen results join the same timeline.
+        self.run_id = read_run_id(cfg.train.train_dir)
+        self.spans = spans if spans is not None else SpanTracer(
+            cfg.train.train_dir, enabled=False)
         self.registry.mark_unhealthy(
             "loading: compiling bucketed batch shapes")
         self._reload_every = float(cfg.serve.reload_interval_secs)
@@ -134,6 +144,7 @@ class PredictServer:
             buckets=self.buckets, max_queue=cfg.serve.max_queue,
             between_batches=self._between_batches,
             on_stats=self._publish_stats,
+            observe=self._observe_sample,
             latency_ring=cfg.serve.latency_ring)
         self._httpd = ThreadingHTTPServer((cfg.serve.host, cfg.serve.port),
                                           self._make_handler())
@@ -151,7 +162,10 @@ class PredictServer:
         warmup see an honest 503, not a connection refused."""
         self._http_thread.start()
         t0 = time.monotonic()
-        self.backend.warmup(self.buckets)
+        with self.spans.span("serve_warmup",
+                             buckets=list(map(int, self.buckets)),
+                             model_step=int(self.backend.model_step)):
+            self.backend.warmup(self.buckets)
         log.info("serve: warmed %d bucket shapes %s in %.1fs",
                  len(self.buckets), list(self.buckets),
                  time.monotonic() - t0)
@@ -166,9 +180,12 @@ class PredictServer:
         server keeps answering (healthz reports draining) until
         :meth:`close`."""
         self.registry.mark_unhealthy("draining")
-        return self.batcher.drain(
-            self.cfg.serve.drain_timeout_secs if timeout is None
-            else timeout)
+        with self.spans.span("serve_drain") as attrs:
+            clean = self.batcher.drain(
+                self.cfg.serve.drain_timeout_secs if timeout is None
+                else timeout)
+            attrs["clean"] = clean
+        return clean
 
     def close(self) -> None:
         if self._closed:
@@ -192,9 +209,22 @@ class PredictServer:
         if now < self._next_reload:
             return
         self._next_reload = now + self._reload_every
+        t0 = time.time()
         if self.backend.maybe_reload():
             self.registry.set("serve_model_step", self.backend.model_step)
             self.registry.set("serve_reloads_total", self.backend.reloads)
+            self.spans.record("serve_reload", t0, time.time(),
+                              model_step=int(self.backend.model_step),
+                              reloads=int(self.backend.reloads))
+
+    def _observe_sample(self, name: str, value: float) -> None:
+        """Batcher distribution samples → Prometheus histograms (the live
+        p50/p95/p99 source the SLO-aware bucket retuning will read)."""
+        self.registry.observe({
+            "latency_ms": "serve_latency_ms",
+            "queue_wait_ms": "serve_queue_wait_ms",
+            "pad_fraction": "serve_pad_fraction",
+        }.get(name, f"serve_{name}"), value)
 
     def _publish_stats(self, stats: dict) -> None:
         self.registry.update({
@@ -258,6 +288,7 @@ class PredictServer:
     def info(self) -> dict:
         return {
             "backend": type(self.backend).__name__,
+            "run_id": self.run_id,
             "model_step": int(self.backend.model_step),
             "reloads": int(self.backend.reloads),
             "image_shape": list(self.image_shape),
@@ -323,11 +354,12 @@ class PredictServer:
         return Handler
 
 
-def write_discovery(train_dir: str, port: int) -> None:
+def write_discovery(train_dir: str, port: int,
+                    run_id: Optional[str] = None) -> None:
     """Atomic ``<train_dir>/serve.json`` — the telemetry.json analog for
     the predict server (loadgen/doctor dial the port from here)."""
     os.makedirs(train_dir, exist_ok=True)
-    record = {"port": port, "pid": os.getpid(),
+    record = {"port": port, "pid": os.getpid(), "run_id": run_id,
               "hostname": socket.gethostname(), "started_at": time.time()}
     path = os.path.join(train_dir, SERVE_DISCOVERY)
     tmp = path + f".tmp{os.getpid()}"
@@ -348,17 +380,24 @@ def serve(cfg: RunConfig) -> int:
     """CLI entry: start, announce, block until SIGTERM/SIGINT, drain,
     exit 0 on a clean drain (the contract ``doctor --serve-probe``
     verifies)."""
+    from tpu_resnet.obs.trace import SERVE_EVENTS_FILE
     from tpu_resnet.resilience import ShutdownCoordinator
 
     coordinator = ShutdownCoordinator(
         enabled=cfg.resilience.graceful_shutdown,
         action_desc="draining the predict server (stop accepting, flush "
                     "the request queue), then exiting 0")
-    server = PredictServer(cfg)
+    # Serve-side timeline: warmup/reload/drain spans land beside the
+    # trainer's events.jsonl (same train_dir, same run_id) so
+    # trace-export renders one correlated session.
+    spans = SpanTracer(cfg.train.train_dir, filename=SERVE_EVENTS_FILE,
+                       run_id=read_run_id(cfg.train.train_dir))
+    server = PredictServer(cfg, spans=spans)
     clean = True
     with coordinator:
         server.start()
-        write_discovery(cfg.train.train_dir, server.port)
+        write_discovery(cfg.train.train_dir, server.port,
+                        run_id=server.run_id)
         log.info("serve: ready on :%d — backend=%s model_step=%d "
                  "buckets=%s max_wait_ms=%s (POST /predict; /metrics; "
                  "/healthz)", server.port, cfg.serve.backend,
@@ -376,6 +415,7 @@ def serve(cfg: RunConfig) -> int:
             clean = False
         finally:
             server.close()
+            spans.close()
     if clean:
         log.info("serve: drained cleanly, exiting 0")
     return 0 if clean else 1

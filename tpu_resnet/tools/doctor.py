@@ -42,6 +42,15 @@ Checks:
              and verify the graceful drain exits 0. Proves the whole
              serving contract (tpu_resnet/serve; docs/SERVING.md) on
              this machine before a real deployment bets on it.
+  trace_probe  optional (--trace-probe): a live observability drill —
+             tiny CPU train with telemetry up, /metrics scraped MID-RUN
+             until the live mfu gauge and train_step_ms histogram carry
+             data, graceful SIGTERM, then trace-export + Chrome-trace
+             schema check with run_id correlation
+             (docs/OBSERVABILITY.md)
+  perfwatch  optional (--perfwatch): perf-regression verdict over the
+             archived BENCH_*.json trajectory (tools/perfwatch.py) —
+             fails only on a regress verdict outside the noise band
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -372,6 +381,157 @@ def _check_serve_probe(timeout: int = 300) -> dict:
             log_fh.close()
 
 
+def _check_trace_probe(timeout: int = 300) -> dict:
+    """Live observability drill (tpu_resnet/obs): tiny CPU train with the
+    telemetry server up, scrape /metrics MID-RUN until the live ``mfu``
+    gauge and the ``train_step_ms`` histogram series carry data, SIGTERM
+    the run (graceful-preemption contract), then ``trace-export`` the
+    train_dir and schema-check the merged Chrome trace — run_id in the
+    trace must match the manifest's. Proves the whole performance-
+    observability chain (gauges → histograms → spans → timeline) on this
+    machine in one check."""
+    import signal
+    import tempfile
+    import time
+
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+    from tpu_resnet.obs.server import (parse_histograms, parse_prometheus,
+                                       read_telemetry_port)
+    from tpu_resnet.obs.trace import export_trace
+    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_trace_") as d:
+        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
+                     "--preset", "smoke", f"train.train_dir={d}",
+                     "train.train_steps=2000", "train.log_every=2",
+                     "train.summary_every=2", "train.checkpoint_every=50",
+                     "train.image_summary_every=0",
+                     "train.steps_per_call=2", "train.telemetry_port=0",
+                     "model.name=mlp", "data.device_resident=off",
+                     "data.transfer_stage=1"]
+        env = scrubbed_cpu_env(1)
+        # A known per-chip peak makes the mfu gauge genuinely nonzero on
+        # CPU — the probe then checks LIVE utilization accounting, not
+        # just series presence. (BENCH_, not TPU_: the scrub strips TPU_*.)
+        env["BENCH_PEAK_FLOPS"] = "1e12"
+        log_path = os.path.join(d, "trace_probe_child.log")
+        log_fh = open(log_path, "w")
+
+        def _tail():
+            log_fh.flush()
+            try:
+                with open(log_path) as f:
+                    return f.read().strip().splitlines()[-5:]
+            except OSError:
+                return []
+
+        proc = subprocess.Popen(train_cmd, env=env, stdout=log_fh,
+                                stderr=subprocess.STDOUT, text=True)
+        try:
+            import urllib.request
+
+            live = {}
+            deadline = time.time() + timeout
+            while time.time() < deadline and proc.poll() is None:
+                port = read_telemetry_port(d)
+                if port is not None:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/metrics",
+                                timeout=2) as r:
+                            text = r.read().decode()
+                        metrics = parse_prometheus(text)
+                        hists = parse_histograms(text)
+                        if (metrics.get("tpu_resnet_mfu", 0) > 0
+                                and hists.get("tpu_resnet_train_step_ms",
+                                              {}).get("count", 0) > 0):
+                            live = {
+                                "mfu": metrics["tpu_resnet_mfu"],
+                                "model_flops_per_sec": metrics.get(
+                                    "tpu_resnet_model_flops_per_sec"),
+                                "step_ms_observations": hists[
+                                    "tpu_resnet_train_step_ms"]["count"],
+                            }
+                            break
+                    except (OSError, ValueError):
+                        pass  # not listening yet / mid-write
+                time.sleep(0.3)
+            if not live:
+                proc.kill()
+                proc.wait(timeout=10)
+                return {"ok": False, "phase": "live_scrape",
+                        "error": "mfu gauge / train_step_ms histogram "
+                                 "never went live", "tail": _tail()}
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                return {"ok": False, "phase": "preempt",
+                        "error": "trainer did not exit within 120s of "
+                                 "SIGTERM", "tail": _tail()}
+            if rc not in (0, PREEMPT_EXIT_CODE):
+                return {"ok": False, "phase": "preempt", "rc": rc,
+                        "tail": _tail()}
+            try:
+                path, trace = export_trace(d)
+            except (OSError, ValueError) as e:
+                return {"ok": False, "phase": "trace_export",
+                        "error": f"{type(e).__name__}: {e}"}
+            with open(os.path.join(d, "manifest.json")) as f:
+                manifest_run_id = json.load(f).get("run_id")
+            ok = (manifest_run_id is not None
+                  and trace["metadata"]["run_id"] == manifest_run_id)
+            span_names = {e["name"] for e in trace["traceEvents"]}
+            return {"ok": ok and {"run", "compile"} <= span_names,
+                    "run_id": manifest_run_id,
+                    "trace_events": len(trace["traceEvents"]),
+                    "preempt_rc": rc, **live}
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            log_fh.close()
+
+
+def _check_perfwatch() -> dict:
+    """Perf-regression verdict over the repo's archived BENCH_*.json
+    trajectory (tools/perfwatch.py). ``ok`` is False only on a REGRESS
+    verdict — flat/improving/insufficient-data trajectories pass, and a
+    checkout without bench artifacts (installed wheel) reports
+    skipped=True."""
+    import tempfile
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = os.path.join(root, "tools", "perfwatch.py")
+    if not os.path.exists(script):
+        return {"ok": True, "skipped": True,
+                "reason": "tools/perfwatch.py not present (installed "
+                          "package?)"}
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_pw_") as d:
+        out_json = os.path.join(d, "verdict.json")
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, "--root", root,
+                 "--json", out_json],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=60)
+        except subprocess.TimeoutExpired:
+            return {"ok": False, "error": "perfwatch hung for 60s"}
+        try:
+            with open(out_json) as f:
+                verdict = json.load(f)
+        except (OSError, ValueError):
+            return {"ok": False, "rc": proc.returncode,
+                    "tail": proc.stdout.strip().splitlines()[-5:]}
+        out = {"ok": proc.returncode == 0, "rc": proc.returncode,
+               "overall": verdict.get("overall")}
+        for name, m in (verdict.get("metrics") or {}).items():
+            out[name] = {k: m.get(k) for k in
+                         ("verdict", "latest", "reference", "ratio")}
+        return out
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -416,6 +576,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                fault_drill: bool = False, data_bench: bool = False,
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
+               trace_probe: bool = False, perfwatch: bool = False,
                stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
@@ -452,6 +613,12 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if serve_probe:
         summary["serve_probe"] = _check_serve_probe()
         emit("serve_probe", summary["serve_probe"])
+    if trace_probe:
+        summary["trace_probe"] = _check_trace_probe()
+        emit("trace_probe", summary["trace_probe"])
+    if perfwatch:
+        summary["perfwatch"] = _check_perfwatch()
+        emit("perfwatch", summary["perfwatch"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

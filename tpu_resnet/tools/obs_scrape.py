@@ -23,12 +23,29 @@ import argparse
 import json
 import sys
 
-from tpu_resnet.obs.server import read_telemetry_port, scrape
+from tpu_resnet.obs.server import (histogram_quantile, read_telemetry_port,
+                                   scrape)
+
+
+def _strict_jsonable(x):
+    """Replace non-finite floats (the +Inf histogram bucket edge) with
+    their Prometheus spellings — json.dumps would otherwise emit bare
+    ``Infinity``, which strict parsers (jq, JSON.parse) reject."""
+    import math
+
+    if isinstance(x, float) and not math.isfinite(x):
+        return "+Inf" if x > 0 else ("-Inf" if x < 0 else "NaN")
+    if isinstance(x, dict):
+        return {k: _strict_jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_strict_jsonable(v) for v in x]
+    return x
 
 
 def format_report(report: dict, as_json: bool = False) -> str:
     if as_json:
-        return json.dumps(report, indent=1, sort_keys=True)
+        return json.dumps(_strict_jsonable(report), indent=1,
+                          sort_keys=True)
     health = report["health"]
     lines = [
         "health: {} (HTTP {})  step={}  heartbeat_age={}s".format(
@@ -36,8 +53,18 @@ def format_report(report: dict, as_json: bool = False) -> str:
             report["health_status"], health.get("step"),
             health.get("heartbeat_age_sec")),
     ]
+    hists = report.get("histograms") or {}
+    hist_components = {f"{n}{suffix}" for n in hists
+                       for suffix in ("_bucket", "_sum", "_count")}
     for name, value in sorted(report["metrics"].items()):
+        if name in hist_components:
+            continue  # summarized below with real percentiles
         lines.append(f"  {name:<42s} {value:g}")
+    for name, h in sorted(hists.items()):
+        qs = {q: histogram_quantile(h, q) for q in (0.50, 0.95, 0.99)}
+        lines.append(
+            f"  {name:<42s} n={h.get('count', 0)} "
+            f"p50={qs[0.50]:g} p95={qs[0.95]:g} p99={qs[0.99]:g}")
     return "\n".join(lines)
 
 

@@ -8,10 +8,13 @@ Best_Precision curves; `ps1workers1.csv` collects run series).
 Reads ``<dir>/metrics.jsonl`` (train series: loss/precision/lr/steps_per_sec,
 written by train/metrics_io.py) and, when present,
 ``<dir>/eval/metrics.jsonl`` (Precision/Best_Precision vs restored step from
-the eval sidecar) and renders one PNG: precision, loss, throughput, and the
+the eval sidecar) and renders one PNG: precision, loss, throughput, the
 step-time breakdown (data-wait fraction + sampled device step time from
-tpu_resnet/obs/breakdown.py — the "are we input-bound" panel). Also exports
-the merged series as CSV with ``--csv`` (the ps1workers1.csv role).
+tpu_resnet/obs/breakdown.py — the "are we input-bound" panel), and the
+MFU / step-time-percentile panel (the live mfu gauge + train_step_ms
+histogram percentiles from tpu_resnet/obs/mfu.py and obs/server.py — the
+"is the chip utilized" panel). Also exports the merged series as CSV with
+``--csv`` (the ps1workers1.csv role).
 """
 
 from __future__ import annotations
@@ -66,7 +69,7 @@ def plot(train_dir: str, out: Optional[str] = None,
     if csv_out:
         write_csv(train, evals, csv_out)
 
-    fig, axes = plt.subplots(1, 4, figsize=(20, 4))
+    fig, axes = plt.subplots(1, 5, figsize=(25, 4))
     ax = axes[0]
     for key, label in [("precision", "train precision"),
                        ("Precision", None)]:
@@ -130,6 +133,38 @@ def plot(train_dir: str, out: Optional[str] = None,
     h2, l2 = ax2.get_legend_handles_labels()
     if h1 or h2:
         ax.legend(h1 + h2, l1 + l2, loc="upper right")
+    ax.grid(alpha=0.3)
+
+    # MFU + step-time percentile panel (tpu_resnet/obs/mfu.py gauges and
+    # the train_step_ms histogram percentiles the loop records) — the
+    # utilization view the MFU campaign's per-knob wins must move.
+    ax = axes[4]
+    xs, ys = _column(train, "mfu")
+    if xs:
+        ax.plot(xs, [100 * y for y in ys], color="tab:green",
+                label="MFU %")
+        ax.set_ylim(0, max(102, 110 * max(ys)))
+    ax.set_xlabel("step")
+    ax3 = ax.twinx()
+    for key, style in (("train_step_ms_p50", "-"),
+                       ("train_step_ms_p95", "--"),
+                       ("train_step_ms_p99", ":")):
+        xs3, ys3 = _column(train, key)
+        if xs3:
+            ax3.plot(xs3, ys3, linestyle=style, color="tab:purple",
+                     alpha=0.8, label=key.replace("train_step_ms_", "step "))
+    if ax3.get_legend_handles_labels()[0]:
+        ax3.set_ylabel("step ms")
+    title = "MFU / step-time percentiles"
+    flops = next((r["model_flops_per_sec"] for r in reversed(train)
+                  if "model_flops_per_sec" in r), None)
+    if flops is not None:
+        title += f" ({flops / 1e9:.1f} GFLOP/s)"
+    ax.set_title(title)
+    h1, l1 = ax.get_legend_handles_labels()
+    h3, l3 = ax3.get_legend_handles_labels()
+    if h1 or h3:
+        ax.legend(h1 + h3, l1 + l3, loc="upper right")
     ax.grid(alpha=0.3)
 
     fig.tight_layout()

@@ -162,12 +162,19 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     # Observability (tpu_resnet/obs): event spans + run manifest + the
     # per-host telemetry server. Spans/manifest are primary-only like
     # every other writer; the HTTP server runs on EVERY host so a pod can
-    # be scraped for stragglers.
+    # be scraped for stragglers. The run_id (minted once per train_dir,
+    # reused across resumes) correlates this run's artifacts with the
+    # eval sidecar, serve and loadgen on one trace-export timeline.
+    run_id = (obs.ensure_run_id(cfg.train.train_dir)
+              if parallel.is_primary()
+              else obs.read_run_id(cfg.train.train_dir))
     spans = obs.SpanTracer(cfg.train.train_dir,
-                           enabled=parallel.is_primary())
-    obs.write_manifest(cfg.train.train_dir, cfg, mesh)
+                           enabled=parallel.is_primary(), run_id=run_id)
+    obs.write_manifest(cfg.train.train_dir, cfg, mesh, run_id=run_id)
+    from tpu_resnet.obs.server import CORE_HISTOGRAMS
     telemetry = obs.TelemetryRegistry(
-        stale_after_sec=cfg.train.telemetry_stale_sec)
+        stale_after_sec=cfg.train.telemetry_stale_sec,
+        histograms=CORE_HISTOGRAMS)
     telemetry.heartbeat(0)  # alive from startup; re-fired with the real
     server = obs.TelemetryServer.maybe_start(  # step once state is known
         cfg.train.telemetry_port, telemetry, train_dir=cfg.train.train_dir)
@@ -278,7 +285,13 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         run_wall0 = time.time()
         start_step = step
         last_ckpt_step = step  # resumed or fresh: the last synced point
+        last_log_step = step   # for the per-interval step-time histogram
         first_dispatch = True
+        # MFU accounting (obs/mfu.py): per-step FLOPs measured once at
+        # first dispatch; converted to model_flops_per_sec / mfu at every
+        # log boundary (pure host arithmetic — no device syncs).
+        step_flops = None
+        device_kind = mesh.devices.flat[0].device_kind
 
         meter.rate(step)
         last_summary = step
@@ -354,8 +367,29 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 spans.record("compile", now - compile_s, now,
                              seconds=round(compile_s, 3), step=start_step)
                 telemetry.set("compile_seconds", compile_s)
+                if cfg.train.mfu_accounting:
+                    # One abstract trace + HLO cost pass (no second XLA
+                    # compile); charged to the compile window, not to any
+                    # throughput interval — breakdown/meter re-prime below.
+                    t_acct = time.time()
+                    try:
+                        entry = obs.mfu.account_train_step(
+                            cfg, mesh, state, base_step,
+                            per_replica_bn=per_replica_bn,
+                            train_dir=(cfg.train.train_dir
+                                       if parallel.is_primary() else None))
+                        step_flops = entry.get("flops_per_step")
+                        spans.record("mfu_account", t_acct, time.time(),
+                                     flops_per_step=step_flops,
+                                     source=entry.get("flops_source"))
+                    except Exception as e:  # noqa: BLE001 - accounting
+                        log.warning(            # must never kill training
+                            "mfu accounting failed (%s: %s) — mfu gauges "
+                            "stay 0", type(e).__name__, e)
+                    breakdown.reset_interval()
                 meter.rate(step)
                 last_sync = step
+                last_log_step = step
 
             if step % cfg.train.log_every == 0 or step == total:
                 breakdown.sample_device(m, step - last_sync)
@@ -392,11 +426,31 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                     meter.rate(step)  # re-prime the throughput baseline
                     last_sync = step
                     last_ckpt_step = step
+                    last_log_step = step
                     telemetry.heartbeat(step)
                     continue
                 rate = meter.rate(step)
                 if rate:
                     m.update(rate)
+                    # Step-time histogram: the interval's mean step time,
+                    # weighted by its step count — the p50/p95/p99 the
+                    # plot panel and /metrics expose.
+                    telemetry.observe(
+                        "train_step_ms", 1e3 / rate["steps_per_sec"],
+                        n=max(1, step - last_log_step))
+                    for q in (0.50, 0.95, 0.99):
+                        m[f"train_step_ms_p{int(q * 100)}"] = round(
+                            telemetry.hist_percentile("train_step_ms", q),
+                            3)
+                    if step_flops:
+                        # Model FLOPs utilization (obs/mfu.py): achieved
+                        # model FLOP/s vs the mesh's aggregate peak.
+                        mfs = step_flops * rate["steps_per_sec"]
+                        m["model_flops_per_sec"] = mfs
+                        u = obs.mfu.mfu(mfs, device_kind, mesh.size)
+                        if u is not None:
+                            m["mfu"] = round(u, 4)
+                last_log_step = step
                 m.update(breakdown.interval())
                 if host_iter is not None and hasattr(host_iter, "stats"):
                     # Engine cause-signal for data_wait: ring occupancy
