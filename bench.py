@@ -1212,5 +1212,12 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         run_child(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--sweep":
+        # Per-knob autotune sweep (tpu_resnet/tools/sweep.py): budgeted
+        # child per point, resumable, complete RESULT_JSON trajectory —
+        # the MFU campaign's knob rig. Like the parent orchestrator,
+        # this path never imports jax in-process.
+        from tpu_resnet.tools.sweep import main as sweep_main
+        sys.exit(sweep_main(sys.argv[2:]))
     else:
         sys.exit(main())

@@ -1,0 +1,25 @@
+"""Seeded jit-host-sync violations in the fused-epilogue kernel module:
+ops/* is jit scope — the epilogue wrappers trace into every train step
+that enables them, so a host clock or RNG here runs once at trace time
+and bakes garbage (or a sync) into the compiled program."""
+
+import random
+import time
+
+import jax
+
+
+def scale_bias_relu_auto(x, scale, bias):
+    t0 = time.monotonic()                 # flagged: host clock under jit
+    if random.random() < 0.5:             # flagged: host RNG at trace
+        scale = scale * 1.0
+    y = jax.numpy.maximum(x * scale + bias, 0.0)
+    host = jax.device_get(y)              # flagged: device->host transfer
+    print("epilogue took", time.monotonic() - t0, host.shape)  # flagged
+    return y
+
+
+def clean_fold(gamma, beta, mean, var, eps):
+    # Hazard-free function in the same jit-scope file: must stay silent.
+    scale = gamma * jax.lax.rsqrt(var + eps)
+    return scale, beta - mean * scale

@@ -1,0 +1,28 @@
+"""Seeded jit-host-sync violations in the sweep harness's jit-program
+assembly: tools/sweep_measure.py is jit scope — the programs built here
+are exactly what a sweep point measures, so a host sync baked in here
+would corrupt every knob's number (the timing loop belongs in sweep.py,
+the host side)."""
+
+import time
+
+import numpy as np
+
+
+def build_point_programs(cfg, mesh, donate_state=True):
+    t0 = time.perf_counter()              # flagged: host clock
+    seed = np.random.randint(0, 2 ** 31)  # flagged: host RNG at trace
+    state = {"seed": seed}
+
+    def step_fn(state, images, labels):
+        loss = (images.sum() + labels.sum()).item()  # flagged: .item()
+        print("step loss", loss)          # flagged: host I/O
+        return state, {"loss": loss}
+
+    _ = time.perf_counter() - t0
+    return state, step_fn, None
+
+
+def clean_space(space):
+    # Hazard-free function in the same jit-scope file: must stay silent.
+    return sorted(space)

@@ -170,6 +170,33 @@ def test_serve_host_sync_fixture():
     assert not any("clean_helper" in f.message for f in found)
 
 
+def test_epilogue_host_sync_fixture():
+    """ops/epilogue.py sits in the ops/* jit scope: the fused-epilogue
+    wrappers trace into every train step that enables them, so a host
+    clock/RNG/sync seeded there must be flagged."""
+    found = fixture_findings("epilogue_host_sync_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    for hazard in ("time.monotonic", "random.random", "jax.device_get",
+                   "print"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    assert all(f.path == "tpu_resnet/ops/epilogue.py" for f in found)
+    assert not any("clean_fold" in f.message for f in found)
+
+
+def test_sweep_measure_host_sync_fixture():
+    """tools/sweep_measure.py (the sweep harness's jit-program assembly)
+    is jit scope: a host sync baked into the measured programs would
+    corrupt every knob's number — the timing loop belongs in sweep.py."""
+    found = fixture_findings("sweep_host_sync_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    for hazard in ("time.perf_counter", "numpy.random", ".item()",
+                   "print"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    assert all(f.path == "tpu_resnet/tools/sweep_measure.py"
+               for f in found)
+    assert not any("clean_space" in f.message for f in found)
+
+
 def test_mfu_cost_analysis_in_jit_scope_fixture():
     """obs/mfu.py's compile introspection (.cost_analysis()) is a
     one-time host-side startup cost: the rule flags it inside jit-scope

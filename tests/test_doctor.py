@@ -91,3 +91,18 @@ def test_doctor_data_bench_probe():
     assert out["implied_max_steps_per_sec_b128"] > 0
     from tpu_resnet.data import shm_ring
     assert shm_ring.leaked_segments() == ()
+
+
+@pytest.mark.slow  # two real measurement children (~60s CPU); the
+# parent-side sweep logic keeps fast coverage in tests/test_sweep.py
+def test_doctor_sweep_probe():
+    """`doctor --sweep-probe` contract: the 2-point sweep completes with
+    a complete trajectory, children honor the BENCH_CHILD_DEADLINE, and
+    perfwatch ingests the artifact."""
+    from tpu_resnet.tools.doctor import _check_sweep_probe
+
+    result = _check_sweep_probe()
+    assert result["ok"], result
+    assert result["complete"] and result["deadline_honored"]
+    assert result["statuses"] == {"base": "ok", "transfer_stage=2": "ok"}
+    assert result["perfwatch_ingested"] is True

@@ -1,0 +1,278 @@
+"""Sweep harness (tpu_resnet/tools/sweep.py): deterministic knob-space
+enumeration, resumable budgeted execution (completed points skipped,
+timed-out points marked skipped — never lost), trajectory completeness,
+and the perfwatch round-trip (cohorting + regress gating). Parent-side
+logic is exercised with an injected runner — no jax, no subprocesses;
+the real end-to-end child path is `doctor --sweep-probe`
+(tests/test_doctor.py slow tier)."""
+
+import copy
+import json
+import sys
+import types
+
+import pytest
+
+from tpu_resnet.tools import sweep
+
+SPACE = {"transfer_stage": [1, 8], "donate": [True, False],
+         "batch": [128]}
+
+
+def _args(tmp_path, **overrides):
+    base = dict(space=copy.deepcopy(SPACE), grid=False, max_points=0,
+                out=str(tmp_path / "points.jsonl"),
+                json="", budget=0.0, point_timeout=60, point_est=1.0,
+                warmup=1, measure=2, split=64, size=8, image=32,
+                model="mlp", dtype="float32", batch=128)
+    base.update(overrides)
+    return types.SimpleNamespace(**base)
+
+
+def _ok_runner(calls=None):
+    def runner(cmd, env, timeout):
+        point = json.loads(cmd[cmd.index("--point") + 1])
+        if calls is not None:
+            calls.append((point["id"], env, timeout))
+        rec = {"id": point["id"], "knobs": point["knobs"],
+               "status": "ok", "backend": "cpu", "n_devices": 1,
+               "steps_per_sec": 100.0 - len(point["id"]),
+               "images_per_sec": 1.0}
+        return 0, "RESULT_JSON: " + json.dumps(rec) + "\n"
+    return runner
+
+
+# ------------------------------------------------------------- enumeration
+def test_enumerate_axes_deterministic_and_per_knob():
+    pts = sweep.enumerate_points(SPACE)
+    assert pts == sweep.enumerate_points(copy.deepcopy(SPACE))
+    ids = [p["id"] for p in pts]
+    # base + one point per alternative value of each knob, sorted knobs
+    assert ids == ["base", "donate=0", "transfer_stage=8"]
+    base = pts[0]["knobs"]
+    assert base == {"transfer_stage": 1, "donate": True, "batch": 128}
+
+
+def test_enumerate_grid_covers_product_without_duplicates():
+    pts = sweep.enumerate_points(SPACE, grid=True)
+    assert len(pts) == 4  # 2 stages x 2 donate x 1 batch
+    assert len({p["id"] for p in pts}) == 4
+    assert sweep.enumerate_points(SPACE, grid=True, max_points=2) == pts[:2]
+
+
+def test_default_space_declares_the_campaign_knobs():
+    for knob in ("xla_flags", "donate", "transfer_stage", "prefetch",
+                 "h2d", "fused", "remat", "batch"):
+        assert knob in sweep.DEFAULT_SPACE and sweep.DEFAULT_SPACE[knob]
+
+
+# ------------------------------------------------------- parent orchestration
+def test_run_sweep_complete_trajectory_and_xla_flags_env(tmp_path):
+    space = dict(SPACE, xla_flags=["", "--xla_foo=true"])
+    calls = []
+    args = _args(tmp_path, space=space)
+    pts = sweep.enumerate_points(space)
+    traj = sweep.run_sweep(pts, args, runner=_ok_runner(calls),
+                           env={"XLA_FLAGS": "--existing"})
+    assert [p["id"] for p in traj["points"]] == [p["id"] for p in pts]
+    assert traj["completed"] == len(pts) and traj["skipped"] == 0
+    assert traj["best"]["id"] == "base" and traj["best"]["vs_base"] == 1.0
+    # knob flags are APPENDED to the ambient XLA_FLAGS, and every child
+    # gets the deadline contract
+    by_id = {c[0]: c[1] for c in calls}
+    assert by_id["xla_flags=--xla_foo=true"]["XLA_FLAGS"] == \
+        "--existing --xla_foo=true"
+    assert by_id["base"]["XLA_FLAGS"] == "--existing"
+    assert all("BENCH_CHILD_DEADLINE" in env for _, env, _ in calls)
+
+
+def test_run_sweep_resumes_past_completed_points(tmp_path):
+    args = _args(tmp_path)
+    pts = sweep.enumerate_points(args.space)
+    calls = []
+    sweep.run_sweep(pts, args, runner=_ok_runner(calls))
+    assert len(calls) == 3
+    calls2 = []
+    traj = sweep.run_sweep(pts, _args(tmp_path), runner=_ok_runner(calls2))
+    assert calls2 == []  # nothing re-run
+    assert all(p.get("resumed") for p in traj["points"])
+    assert traj["completed"] == 3
+
+
+def test_run_sweep_timeout_point_marked_not_lost(tmp_path):
+    def runner(cmd, env, timeout):
+        point = json.loads(cmd[cmd.index("--point") + 1])
+        if point["id"] == "donate=0":
+            return 124, "partial output, killed\n"
+        return _ok_runner()(cmd, env, timeout)
+
+    args = _args(tmp_path)
+    pts = sweep.enumerate_points(args.space)
+    traj = sweep.run_sweep(pts, args, runner=runner)
+    by_id = {p["id"]: p for p in traj["points"]}
+    assert by_id["donate=0"]["status"] == "skipped_timeout"
+    assert by_id["base"]["status"] == "ok"
+    assert len(traj["points"]) == 3  # complete: no lost points
+    # the timed-out point is retried on resume (only ok points skip)
+    calls2 = []
+    sweep.run_sweep(pts, _args(tmp_path), runner=_ok_runner(calls2))
+    assert [c[0] for c in calls2] == ["donate=0"]
+
+
+def test_run_sweep_budget_exhaustion_marks_skipped_budget(tmp_path):
+    args = _args(tmp_path, budget=0.0001, point_est=999.0)
+    pts = sweep.enumerate_points(args.space)
+    traj = sweep.run_sweep(pts, args, runner=_ok_runner())
+    assert all(p["status"] == "skipped_budget" for p in traj["points"])
+    assert len(traj["points"]) == 3 and traj["completed"] == 0
+
+
+def test_run_sweep_error_child_recorded(tmp_path):
+    def runner(cmd, env, timeout):
+        return 1, "Traceback: boom\n"
+
+    args = _args(tmp_path)
+    traj = sweep.run_sweep(sweep.enumerate_points(args.space), args,
+                           runner=runner)
+    assert all(p["status"] == "error" for p in traj["points"])
+    assert traj["errors"] == 3
+
+
+def test_measure_point_honors_child_deadline(monkeypatch, tmp_path):
+    """A child whose remaining deadline cannot cover the estimate must
+    return skipped_budget WITHOUT importing jax or starting work."""
+    import time as time_mod
+
+    monkeypatch.setenv("BENCH_CHILD_DEADLINE",
+                       str(time_mod.time() + 1))
+    args = _args(tmp_path, point_est=999.0)
+    rec = sweep.measure_point({"id": "base", "knobs": {}}, args)
+    assert rec["status"] == "skipped_budget"
+
+
+def test_load_space_validation(tmp_path):
+    with pytest.raises(ValueError):
+        sweep._load_space('{"empty": []}')
+    with pytest.raises(ValueError):
+        sweep._load_space('[1, 2]')
+    p = tmp_path / "space.json"
+    p.write_text(json.dumps(SPACE))
+    assert sweep._load_space(str(p)) == SPACE
+
+
+def test_cli_emits_result_json(tmp_path, capsys, monkeypatch):
+    monkeypatch.setattr(sweep, "_default_runner", _ok_runner())
+    out_json = tmp_path / "traj.json"
+    rc = sweep.main(["--space", json.dumps(SPACE),
+                     "--out", str(tmp_path / "p.jsonl"),
+                     "--json", str(out_json)])
+    assert rc == 0
+    line = [ln for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("RESULT_JSON: ")][-1]
+    traj = json.loads(line[len("RESULT_JSON: "):])
+    assert traj["metric"] == sweep.SWEEP_METRIC
+    assert json.load(open(out_json)) == traj
+
+
+# -------------------------------------------------------- perfwatch round-trip
+def _perfwatch():
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "perfwatch", os.path.join(root, "tools", "perfwatch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trajectory(tmp_path, name, scale=1.0):
+    args = _args(tmp_path, out=str(tmp_path / f"{name}.jsonl"))
+    pts = sweep.enumerate_points(args.space)
+    traj = sweep.run_sweep(pts, args, runner=_ok_runner())
+    for p in traj["points"]:
+        p["steps_per_sec"] *= scale
+    path = tmp_path / f"{name}.json"
+    path.write_text(json.dumps(traj))
+    return str(path)
+
+
+def test_perfwatch_cohorts_sweep_trajectory(tmp_path):
+    pw = _perfwatch()
+    a = _trajectory(tmp_path, "r1")
+    b = _trajectory(tmp_path, "r2", scale=1.0)
+    samples = pw.load_sweep_samples([a, b])
+    names = sorted({s["metric"] for s in samples})
+    assert names == ["sweep:base", "sweep:donate=0",
+                     "sweep:transfer_stage=8"]
+    verdict = pw.judge(samples, noise=0.08, metric_names=names)
+    assert all(v["verdict"] == "flat"
+               for v in verdict["metrics"].values())
+    assert verdict["overall"] == "flat"
+
+
+def test_perfwatch_flags_sweep_regression(tmp_path):
+    pw = _perfwatch()
+    a = _trajectory(tmp_path, "r1")
+    b = _trajectory(tmp_path, "r2", scale=0.5)
+    rc = pw.main(["--sweep", a, "--sweep", b])
+    assert rc == 1  # regress gates
+    rc = pw.main(["--sweep", a, "--sweep", _trajectory(tmp_path, "r3")])
+    assert rc == 0
+
+
+def test_perfwatch_flags_point_that_stopped_completing(tmp_path):
+    """A point that was ok in earlier runs but ends skipped_timeout or
+    error in the newest run must gate as regress (the value-only judge
+    would see no latest sample and degrade to insufficient_data);
+    skipped_budget — the harness's own scheduling — reports
+    not_measured without gating."""
+    pw = _perfwatch()
+    a = _trajectory(tmp_path, "r1")
+    traj = json.loads((tmp_path / "r1.json").read_text())
+    for p in traj["points"]:
+        if p["id"] == "donate=0":
+            p.clear()
+            p.update(id="donate=0", status="skipped_timeout")
+        elif p["id"] == "transfer_stage=8":
+            p.clear()
+            p.update(id="transfer_stage=8", status="skipped_budget")
+    (tmp_path / "r2.json").write_text(json.dumps(traj))
+    rc = pw.main(["--sweep", a, "--sweep", str(tmp_path / "r2.json")])
+    assert rc == 1
+    samples = pw.load_sweep_samples([a, str(tmp_path / "r2.json")])
+    names = sorted({s["metric"] for s in samples})
+    verdict = pw.apply_sweep_statuses(
+        pw.judge(samples, metric_names=names),
+        pw.sweep_point_statuses(str(tmp_path / "r2.json")))
+    assert verdict["metrics"]["sweep:donate=0"]["verdict"] == "regress"
+    assert verdict["metrics"]["sweep:transfer_stage=8"]["verdict"] == \
+        "not_measured"
+    assert verdict["overall"] == "regress"
+
+
+def test_perfwatch_skips_incomplete_points(tmp_path):
+    pw = _perfwatch()
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({
+        "points": [
+            {"id": "a", "status": "ok", "backend": "cpu",
+             "steps_per_sec": 10.0},
+            {"id": "b", "status": "skipped_timeout"},
+            {"id": "c", "status": "error"},
+        ]}))
+    samples = pw.load_sweep_samples([str(path)])
+    assert [s["metric"] for s in samples] == ["sweep:a"]
+
+
+def test_bench_sweep_flag_delegates(monkeypatch, tmp_path):
+    """`python bench.py --sweep ...` reaches the harness without the
+    bench parent importing jax."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--sweep", "--help"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "--space" in proc.stdout and "--point-timeout" in proc.stdout

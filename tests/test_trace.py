@@ -254,3 +254,40 @@ def test_doctor_trace_probe_contract():
     assert out["step_ms_observations"] > 0
     assert out["trace_events"] > 0
     assert out["run_id"]
+
+
+def test_h2d_transfer_lane(tmp_path):
+    """h2d_transfer spans (the double-buffered staged transfers) render
+    on their own named thread of the trainer lane, with the byte counters
+    lifted from metrics.jsonl — the overlap-visibility contract of the
+    MFU campaign's transfer leg."""
+    d = str(tmp_path / "run")
+    t0 = 1_700_000_000.0
+    _write_jsonl(os.path.join(d, "events.jsonl"), [
+        {"span": "run", "start": t0, "end": t0 + 20, "pid": 7,
+         "run_id": "r", "start_step": 0, "stop_step": 10},
+        {"span": "h2d_transfer", "start": t0 + 1.0, "end": t0 + 1.2,
+         "pid": 7, "run_id": "r", "bytes": 147648, "steps": 3},
+        {"span": "h2d_transfer", "start": t0 + 2.0, "end": t0 + 2.3,
+         "pid": 7, "run_id": "r", "bytes": 147648, "steps": 3},
+    ])
+    _write_jsonl(os.path.join(d, "metrics.jsonl"), [
+        {"step": 6, "wall": t0 + 3, "loss": 2.0, "steps_per_sec": 3.0,
+         "data_wait_sec": 0.1, "data_wait_frac": 0.02,
+         "dispatch_sec": 0.4, "h2d_bytes_per_sec": 1.1e6,
+         "h2d_overlap_frac": 0.8},
+    ])
+    trace = build_trace(d)
+    assert validate_trace(trace) == []
+    ev = trace["traceEvents"]
+    h2d = [e for e in ev if e["name"] == "h2d_transfer"]
+    assert len(h2d) == 2
+    assert {e["tid"] for e in h2d} == {4}          # the transfer lane
+    assert all(e["args"]["bytes"] == 147648 for e in h2d)
+    run = next(e for e in ev if e["name"] == "run")
+    assert run["tid"] != h2d[0]["tid"]              # distinct threads
+    names = {(e.get("tid"), e["args"]["name"]) for e in ev
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert (4, "h2d-transfer") in names
+    counters = {e["name"] for e in ev if e["ph"] == "C"}
+    assert {"h2d_bytes_per_sec", "h2d_overlap_frac"} <= counters

@@ -146,14 +146,18 @@ def load_samples(root: str, extra_files=()) -> List[dict]:
     return samples
 
 
-def judge(samples: List[dict], noise: float = 0.08) -> dict:
+def judge(samples: List[dict], noise: float = 0.08,
+          metric_names: Optional[List[str]] = None) -> dict:
     """Per-metric verdicts. For each metric the cohort is the backend of
     its NEWEST sample; reference = median of the cohort's earlier
     samples; the verdict compares latest/reference against the ±noise
-    band."""
+    band. ``metric_names`` overrides the default tracked set (the sweep
+    path passes the per-knob point names discovered in the samples)."""
     verdict: Dict[str, dict] = {}
     errors = [s for s in samples if "error" in s]
-    for name, _ in METRICS:
+    names = (metric_names if metric_names is not None
+             else [n for n, _ in METRICS])
+    for name in names:
         series = [s for s in samples if s.get("metric") == name]
         if not series:
             verdict[name] = {"verdict": "insufficient_data", "samples": 0}
@@ -188,6 +192,94 @@ def judge(samples: List[dict], noise: float = 0.08) -> dict:
             "unparseable_sources": [e["source"] for e in errors]}
 
 
+def sweep_record_of(payload) -> Optional[dict]:
+    """A sweep trajectory (tools/sweep.py ``--json`` artifact, a raw
+    RESULT_JSON dict, or a driver-style {parsed|tail} wrapper) → the
+    trajectory record, else None."""
+    rec = _record_of(payload) if isinstance(payload, dict) else None
+    if isinstance(rec, dict) and isinstance(rec.get("points"), list):
+        return rec
+    return None
+
+
+def sweep_point_statuses(path: str) -> Dict[str, str]:
+    """point id → status for one sweep trajectory file ({} when
+    unreadable)."""
+    try:
+        with open(path) as f:
+            rec = sweep_record_of(json.load(f))
+    except (OSError, ValueError):
+        return {}
+    if rec is None:
+        return {}
+    return {str(p.get("id")): str(p.get("status"))
+            for p in rec["points"] if p.get("id")}
+
+
+def apply_sweep_statuses(verdict: dict, latest_statuses: Dict[str, str]
+                         ) -> dict:
+    """A point that succeeded in earlier sweep runs but FAILED in the
+    newest one is the worst possible regression — value-based judging
+    alone would degrade it to insufficient_data (no latest sample).
+    skipped_timeout/error gate as ``regress``; ``skipped_budget`` is the
+    harness's own scheduling (operator shrank the budget), reported as
+    ``not_measured`` without gating."""
+    for name, entry in verdict["metrics"].items():
+        pid = name[len("sweep:"):]
+        status = latest_statuses.get(pid)
+        if status in (None, "ok"):
+            continue
+        entry["latest_status"] = status
+        if status == "skipped_budget":
+            entry["verdict"] = "not_measured"
+        else:
+            entry["verdict"] = "regress"
+            entry["reason"] = (f"point completed in earlier runs but "
+                               f"ended '{status}' in the newest")
+    verdicts = {v["verdict"] for v in verdict["metrics"].values()}
+    verdict["overall"] = ("regress" if "regress" in verdicts
+                          else "improve" if "improve" in verdicts
+                          else "flat" if "flat" in verdicts
+                          else "insufficient_data")
+    return verdict
+
+
+def load_sweep_samples(paths: List[str]) -> List[dict]:
+    """Per-knob samples from an ordered (oldest → newest) list of sweep
+    trajectory files: every completed point becomes a
+    ``sweep:<point_id>`` metric sample, cohorted by the point's backend
+    like the headline metrics — so a CPU-fallback sweep is never judged
+    against chip numbers."""
+    samples: List[dict] = []
+    for idx, path in enumerate(paths):
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            samples.append({"source": path,
+                            "error": f"{type(e).__name__}: {e}"})
+            continue
+        rec = sweep_record_of(payload)
+        if rec is None:
+            samples.append({"source": path,
+                            "error": "no sweep trajectory (missing "
+                                     "'points')"})
+            continue
+        for point in rec["points"]:
+            if point.get("status") != "ok":
+                continue
+            value = point.get("steps_per_sec")
+            if not isinstance(value, (int, float)) or value <= 0:
+                continue
+            samples.append({
+                "source": os.path.basename(path), "order": idx,
+                "metric": f"sweep:{point.get('id')}",
+                "backend": point.get("backend")
+                           or rec.get("backend") or "unknown",
+                "value": float(value), "partial": False})
+    return samples
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--root", default=os.path.dirname(os.path.dirname(
@@ -201,12 +293,25 @@ def main(argv=None) -> int:
                     help="extra result file(s) to judge as the newest "
                          "run (bench emit JSON or driver round file); "
                          "repeatable")
+    ap.add_argument("--sweep", action="append", default=[],
+                    help="judge per-knob sweep trajectories "
+                         "(tools/sweep.py artifacts) instead of the "
+                         "bench trajectory; repeatable, ordered oldest "
+                         "to newest — each point id is cohorted and "
+                         "judged across the runs")
     ap.add_argument("--json", default="",
                     help="also write the verdict JSON to this path")
     args = ap.parse_args(argv)
 
-    samples = load_samples(args.root, extra_files=args.add)
-    verdict = judge(samples, noise=args.noise)
+    if args.sweep:
+        samples = load_sweep_samples(args.sweep)
+        names = sorted({s["metric"] for s in samples if "metric" in s})
+        verdict = judge(samples, noise=args.noise, metric_names=names)
+        verdict = apply_sweep_statuses(
+            verdict, sweep_point_statuses(args.sweep[-1]))
+    else:
+        samples = load_samples(args.root, extra_files=args.add)
+        verdict = judge(samples, noise=args.noise)
 
     for name, entry in verdict["metrics"].items():
         line = f"[perfwatch] {name:24s} {entry['verdict']:18s}"

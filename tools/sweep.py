@@ -1,0 +1,17 @@
+#!/usr/bin/env python3
+"""CLI shim for the per-knob sweep harness — see
+tpu_resnet/tools/sweep.py (the package module; also reachable as
+``python bench.py --sweep`` and ``python -m tpu_resnet.tools.sweep``).
+
+    python tools/sweep.py --space '{"transfer_stage": [1, 8, 16]}'
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_resnet.tools.sweep import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

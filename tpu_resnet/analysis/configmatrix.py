@@ -93,6 +93,7 @@ class MatrixEntry:
     dtype: str = "float32"
     fused: bool = False
     remat: bool = False
+    epilogue: str = "off"          # model.fused_epilogue (off | on | auto)
     sync_bn: bool = True
     s2d: bool = True               # model.stem_space_to_depth
     data_axis: int = 1
@@ -104,8 +105,14 @@ class MatrixEntry:
     expect_error: Optional[str] = None
     # "config" entries build through RunConfig/build_model; "ctor-bn-axis"
     # calls the public constructor directly with bn_axis_name+fused (the
-    # ADVICE r4 bypass path).
+    # ADVICE r4 bypass path); "staged-chunk" traces the fused multi-step
+    # chunk program over a staged superbatch (device_data.make_chunk_fn
+    # — the program the double-buffered H2D path dispatches) instead of
+    # the single step.
     builder: str = "config"
+    # staged-chunk only: steps fused per dispatch / superbatch stage rows.
+    chunk_steps: int = 4
+    stage_rows: int = 8
     # Assert hash-equality with another entry (e.g. engine must not
     # change the compiled program).
     same_program_as: Optional[str] = None
@@ -127,6 +134,7 @@ class MatrixEntry:
         cfg.model.compute_dtype = self.dtype
         cfg.model.fused_blocks = self.fused
         cfg.model.remat = self.remat
+        cfg.model.fused_epilogue = self.epilogue
         cfg.model.sync_bn = self.sync_bn
         cfg.model.stem_space_to_depth = self.s2d
         cfg.mesh.data = self.data_axis
@@ -189,12 +197,33 @@ MATRIX: Tuple[MatrixEntry, ...] = (
        dtype="bfloat16"),
     _e("imagenet_rn50_bf16_fused", dataset="imagenet", size=50,
        dtype="bfloat16", fused=True),
+    # --- fused Pallas epilogues (ops/epilogue.py, MFU campaign) -------
+    # "on" pins the kernel-everywhere program (what a forced run and the
+    # CPU parity tests compile); the per-replica row pins the supported
+    # multi-chip dispatch. "auto" is probe-dependent by design and so
+    # cannot carry a golden — its safety net is that every unprobed
+    # shape lowers to the same XLA math as these rows' reference arm.
+    _e("cifar10_rn8_f32_epilogue", epilogue="on"),
+    _e("imagenet_rn18_bf16_epilogue", dataset="imagenet", size=18,
+       dtype="bfloat16", epilogue="on"),
+    _e("cifar10_rn8_f32_mesh8_perreplica_epilogue", data_axis=8,
+       sync_bn=False, epilogue="on"),
+    # --- staged/double-buffered chunk program (device_data.make_chunk_fn)
+    # The fused multi-step dispatch both streaming input edges execute —
+    # including the new DoubleBufferedH2D path, whose contract is that
+    # it changes TRANSFER scheduling only, never the compiled program.
+    _e("cifar10_rn8_f32_staged_chunk", builder="staged-chunk"),
+    _e("imagenet_rn18_bf16_staged_chunk", dataset="imagenet", size=18,
+       dtype="bfloat16", builder="staged-chunk"),
     # --- guard contracts: unsupported combinations must raise ---------
     _e("raise_fused_wrn", dataset="cifar100", size=28, width=10,
        fused=True,
        expect_error="only measured/tiled for.*width_multiplier"),
     _e("raise_fused_syncbn_mesh8", fused=True, data_axis=8,
        expect_error="multi-chip data axis requires.*sync_bn"),
+    _e("raise_epilogue_syncbn_mesh8", epilogue="on", data_axis=8,
+       expect_error="fused_epilogue on a multi-chip data axis "
+                    "requires.*sync_bn"),
     _e("raise_ctor_fused_bn_axis", builder="ctor-bn-axis",
        expect_error="does not implement sync-BN"),
 )
@@ -269,9 +298,25 @@ def _abstract_programs(entry: MatrixEntry):
 
     imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
     labels = jax.ShapeDtypeStruct((entry.batch,), jnp.int32)
-    train_text = canonicalize(str(jax.make_jaxpr(step)(
-        state_sds, imgs, labels)))
-    out_shapes = jax.eval_shape(step, state_sds, imgs, labels)
+    if entry.builder == "staged-chunk":
+        # The fused multi-step chunk over a staged superbatch — exactly
+        # the program compile_staged_stream_steps jits for the streaming
+        # (and double-buffered H2D) input edge.
+        from tpu_resnet.data.device_data import make_chunk_fn
+
+        chunk = make_chunk_fn(step, entry.chunk_steps)
+        gi = jax.ShapeDtypeStruct(
+            (entry.stage_rows, entry.batch, size, size, 3), jnp.uint8)
+        gl = jax.ShapeDtypeStruct((entry.stage_rows, entry.batch),
+                                  jnp.int32)
+        off = jax.ShapeDtypeStruct((), jnp.int32)
+        train_text = canonicalize(str(jax.make_jaxpr(chunk)(
+            state_sds, gi, gl, off)))
+        out_shapes = jax.eval_shape(chunk, state_sds, gi, gl, off)
+    else:
+        train_text = canonicalize(str(jax.make_jaxpr(step)(
+            state_sds, imgs, labels)))
+        out_shapes = jax.eval_shape(step, state_sds, imgs, labels)
 
     eval_step = make_eval_step(model, cfg.data.num_classes, eval_pre)
     eval_text = canonicalize(str(jax.make_jaxpr(eval_step)(

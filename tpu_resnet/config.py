@@ -102,6 +102,17 @@ class DataConfig:
     # b128, bandwidth-bound link): stage 4/8/16 → 88.1/96.4/104.4 st/s;
     # 8 takes most of the amortization at half 16's staging HBM.
     transfer_stage: int = 8
+    # Double-buffered H2D prefetch (data/pipeline.py::DoubleBufferedH2D):
+    # a producer thread assembles the NEXT staged superbatch and runs its
+    # host->device transfer to completion while the loop dispatches
+    # compute on the current one — an explicit two-slot device buffer,
+    # recycled between stages. Gauges h2d_bytes_per_sec /
+    # h2d_overlap_frac and the trace-export transfer lane make the
+    # overlap visible (docs/OBSERVABILITY.md). Off = the plain staged
+    # generator (transfer serialized with superbatch assembly on the
+    # consumer thread). Superbatch CONTENTS are identical either way —
+    # loss streams are bit-equal (tests/test_data.py).
+    h2d_double_buffer: bool = True
 
     @property
     def num_classes(self) -> int:
@@ -177,6 +188,18 @@ class ModelConfig:
     # Forward batch tile of the fused kernels (backward tile derives from
     # it); tunable from tools/fused_model_ab.py --batch-tile.
     fused_block_tile: int = 16
+    # Fused Pallas conv epilogues (ops/epilogue.py): every BN+ReLU site
+    # runs as one VMEM-resident scale-bias-ReLU kernel over the conv
+    # output instead of XLA's separate fused loops. "auto": the loop
+    # probes each stage shape at startup (ops.probe_model_epilogues) and
+    # only shapes with a measured win dispatch to Pallas — unprofitable
+    # shapes keep the identical XLA math. "on" forces the kernel
+    # everywhere (tests / forced runs); "off" keeps nn.BatchNorm.
+    # Multi-chip: supported via the per-replica-BN shard_map path only
+    # (model.sync_bn=false), same rule as fused_blocks — the train loop
+    # and the config matrix both enforce it (train/step.py
+    # check_step_config).
+    fused_epilogue: str = "off"  # off | on | auto
     # MLP sanity model (reference logist_model.py:11) hidden units.
     mlp_hidden_units: int = 100
 
@@ -204,12 +227,13 @@ class OptimConfig:
     weight_decay_on_bn: bool = True
     label_smoothing: float = 0.0
     # Fused Pallas softmax-xent kernel (tpu_resnet/ops) on TPU backends;
-    # falls back to the optax chain on CPU or when label_smoothing != 0.
-    # Default OFF: the scan-fused A/B on v5e measured 0.90x (b128x10) /
-    # 0.99x (b128x1000) vs plain XLA (docs/runs/bench_r3_tpu_v5e.json
-    # .pallas_xent_ab) — XLA's own fusion already wins; the kernel stays
-    # in ops/ as an opt-in and a Pallas exemplar.
-    use_pallas_xent: bool = False
+    # the optax chain always serves CPU and label_smoothing != 0.
+    # "auto" (default): a compile-time per-shape A/B probe
+    # (ops/autotune.py + softmax_xent.ensure_xent_probe) times both
+    # lowerings at step-build time and dispatches the measured winner —
+    # the BENCH_r04 0.901x regression class auto-falls back to XLA.
+    # "on" forces the (retuned, lane-tiled) kernel; "off" forces XLA.
+    use_pallas_xent: str = "auto"  # auto | on | off
     # warmup schedule knobs (imagenet_warmup)
     warmup_steps: int = 6240
     warmup_init_lr: float = 0.1

@@ -121,12 +121,40 @@ class DeviceDataset:
             self._epoch = epoch
 
 
+def make_chunk_fn(base_step: Callable, c: int):
+    """The fused ``c``-step chunk program over a staged ``(stage, B, ...)``
+    superbatch — ``chunk(state, gi, gl, off)`` scans steps ``off ..
+    off + c``. Module-level (not a closure of the compile cache) so the
+    config-matrix verifier can trace and golden-pin exactly the program
+    the staged/double-buffered H2D path dispatches
+    (tpu_resnet/analysis/configmatrix.py ``staged-chunk`` entries)."""
+
+    def chunk(state, gi, gl, off):
+        imgs = jax.lax.dynamic_slice_in_dim(gi, off, c, axis=0)
+        labs = jax.lax.dynamic_slice_in_dim(gl, off, c, axis=0)
+        if c == 1:
+            return base_step(state, imgs[0], labs[0])
+
+        def body(s, xs):
+            s2, _ = base_step(s, xs[0], xs[1])
+            return s2, None
+
+        state, _ = jax.lax.scan(
+            body, state, (imgs[:-1], labs[:-1]))
+        return base_step(state, imgs[-1], labs[-1])
+
+    return chunk
+
+
 def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
-                                per_replica_bn: bool = False):
+                                per_replica_bn: bool = False,
+                                donate_state: bool = True):
     """Fused multi-step dispatch for the *streaming* input path — the
     counterpart of ``compile_resident_steps`` for data that arrives as
     staged ``(stage, B, ...)`` superbatches
-    (pipeline.staged_superbatch_prefetch).
+    (pipeline.staged_superbatch_prefetch). ``donate_state=False`` is the
+    sweep harness's donation knob (tools/sweep.py) — production callers
+    keep the default in-place update.
 
     Returns ``run(state, gi, gl, off, c) -> (state, metrics)`` executing
     steps ``off .. off+c`` of the superbatch in ONE dispatch (a
@@ -143,20 +171,7 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
 
     def compiled(c: int):
         if c not in cache:
-            def chunk(state, gi, gl, off):
-                imgs = jax.lax.dynamic_slice_in_dim(gi, off, c, axis=0)
-                labs = jax.lax.dynamic_slice_in_dim(gl, off, c, axis=0)
-                if c == 1:
-                    return base_step(state, imgs[0], labs[0])
-
-                def body(s, xs):
-                    s2, _ = base_step(s, xs[0], xs[1])
-                    return s2, None
-
-                state, _ = jax.lax.scan(
-                    body, state, (imgs[:-1], labs[:-1]))
-                return base_step(state, imgs[-1], labs[-1])
-
+            chunk = make_chunk_fn(base_step, c)
             if per_replica_bn:
                 from tpu_resnet.train.step import per_replica_shard_map
 
@@ -166,7 +181,7 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
             cache[c] = jax.jit(
                 chunk,
                 in_shardings=(repl, staged, staged, None),
-                donate_argnums=(0,),
+                donate_argnums=(0,) if donate_state else (),
             )
         return cache[c]
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -282,6 +283,209 @@ def staged_superbatch_prefetch(host_iter: Iterator[Batch], stage_sharding,
         except StopIteration:
             pass
         yield nxt
+
+
+class DoubleBufferedH2D:
+    """Double-buffered staged H2D prefetch — the transfer-overlap form of
+    :func:`staged_superbatch_prefetch`.
+
+    The generator form assembles and transfers each superbatch on the
+    CONSUMER thread between dispatches: with async PJRT transfers the
+    copy usually overlaps compute anyway, but the np.stack assembly and
+    the transfer *enqueue* serialize with dispatch, and nothing measures
+    whether the link kept up. This class moves the whole stage onto a
+    producer thread and makes the overlap an explicit, gauged contract:
+
+    - the producer assembles the next ``(stage, B, ...)`` superbatch,
+      issues its host→device transfer and BLOCKS until the copy lands —
+      transfer wall time and bytes are measured per stage;
+    - an explicit two-slot device buffer bounds in-flight HBM: one
+      superbatch being consumed, one ready/landing — independent of
+      ``data.prefetch`` (the queue is clamped to one ready slot; during
+      the handoff instant a third superbatch can be live transiently:
+      consuming + ready + just-landed-blocked-on-put). The consumer
+      dropping its reference at the stage end releases the slot the next
+      transfer fills (donated between stages via buffer refcount);
+    - ``stats()`` reports interval ``h2d_bytes_per_sec`` and
+      ``h2d_overlap_frac`` (fraction of transfer wall time hidden under
+      consumer compute: 1 − consumer-blocked-time ∕ transfer-time,
+      clamped to [0, 1]) — the loop publishes both as gauges;
+    - ``drain_transfers()`` hands finished (start, end, bytes, k)
+      records to the loop, which lays them on the trace-export transfer
+      lane (``h2d_transfer`` spans; docs/OBSERVABILITY.md).
+
+    Superbatch CONTENTS are identical to the generator form (same stream,
+    same np.stack), so staged-vs-unstaged loss bit-equality is preserved
+    (tests/test_data.py). The assembly look-back into a shm-ring source
+    is unchanged (one superbatch: ``stage`` draws, copied out at stack
+    time), so the engine's ``hold = stage + 1`` contract still covers the
+    extra in-flight transfer.
+
+    Consumer contract matches the generator plus ``close()``/``stats()``:
+    iterate for ``(gi, gl, k)``; a producer error re-raises at the
+    consumer; ``external_stop`` ends iteration within ~GET_POLL_SEC even
+    mid-stall (the preemption contract BackgroundIterator documents).
+    """
+
+    _DONE = object()
+
+    def __init__(self, host_iter: Iterator[Batch], stage_sharding,
+                 stage: int = 4, depth: int = 2,
+                 external_stop: Optional[threading.Event] = None):
+        self._time = time.perf_counter
+        self._stage = max(1, int(stage))
+        self._sharding = stage_sharding
+        self._it = iter(host_iter)
+        # Two-slot contract: ONE ready superbatch in the queue, one in
+        # flight at the producer — the staging-HBM bound must not scale
+        # with data.prefetch (``depth`` is accepted for signature parity
+        # with the generator form but deliberately does not widen the
+        # queue: at ImageNet scale each extra slot is hundreds of MB of
+        # device memory behind a knob documented as host-side buffering).
+        del depth
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._stop = threading.Event()
+        self._external_stop = external_stop
+        self._lock = threading.Lock()
+        self._events = []           # finished transfers: (t0, t1, bytes, k)
+        self._bytes = 0             # interval accumulators for stats()
+        self._transfer_sec = 0.0
+        self._wait_sec = 0.0
+        self._last_stats = self._time()
+        self._thread = threading.Thread(target=self._fill, daemon=True,
+                                        name="tpu-resnet-h2d")
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _assemble(self):
+        imgs, labs = [], []
+        while len(imgs) < self._stage:
+            try:
+                im, lb = next(self._it)
+            except StopIteration:
+                break
+            imgs.append(im)
+            labs.append(lb)
+        if not imgs:
+            return None
+        return np.stack(imgs), np.stack(labs)
+
+    def _fill(self):
+        try:
+            while not self._stop.is_set():
+                stacked = self._assemble()
+                if stacked is None:
+                    self._put(self._DONE)
+                    return
+                imgs, labs = stacked
+                t0 = self._time()
+                gi = jax.make_array_from_process_local_data(
+                    self._sharding, imgs)
+                gl = jax.make_array_from_process_local_data(
+                    self._sharding, labs)
+                # Land the copy HERE, on the producer: the consumer never
+                # blocks on an in-flight transfer, and (t1 - t0) is the
+                # honest transfer wall time this thread observed.
+                jax.block_until_ready((gi, gl))
+                t1 = self._time()
+                nbytes = imgs.nbytes + labs.nbytes
+                with self._lock:
+                    self._events.append((t0, t1, nbytes, len(imgs)))
+                    self._bytes += nbytes
+                    self._transfer_sec += t1 - t0
+                if not self._put((gi, gl, len(imgs))):
+                    return
+        except Exception as e:  # surface loader/transfer errors in order
+            try:
+                self._q.put(e, timeout=ERROR_PUT_TIMEOUT_SEC)
+            except queue.Full:
+                self._drain()
+                try:
+                    self._q.put_nowait(e)
+                except queue.Full:  # pragma: no cover - sole producer
+                    pass
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _drain(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = self._time()
+        while True:
+            try:
+                item = self._q.get(timeout=GET_POLL_SEC)
+                break
+            except queue.Empty:
+                if (self._external_stop is not None
+                        and self._external_stop.is_set()):
+                    raise StopIteration  # preemption: stop waiting
+                if self._thread.is_alive():
+                    continue
+                try:
+                    item = self._q.get_nowait()
+                    break
+                except queue.Empty:
+                    raise RuntimeError(
+                        "DoubleBufferedH2D producer thread died without "
+                        "yielding a result or an error") from None
+        with self._lock:
+            self._wait_sec += self._time() - t0
+        if item is self._DONE:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Release the producer thread and the buffered device slots.
+        Idempotent; sits in the loop's closer chain like the engine."""
+        self._stop.set()
+        self._drain()
+        self._thread.join(timeout=5)
+
+    # --------------------------------------------------------------- stats
+    def drain_transfers(self):
+        """Finished transfer records since the last drain, as
+        ``(start, end, bytes, k)`` in this host's perf_counter domain
+        plus the matching wall-clock offset — the loop converts them to
+        ``h2d_transfer`` spans (single-threaded span writer by design)."""
+        with self._lock:
+            events, self._events = self._events, []
+        offset = time.time() - self._time()
+        return [(t0 + offset, t1 + offset, nbytes, k)
+                for t0, t1, nbytes, k in events]
+
+    def stats(self) -> dict:
+        """Interval gauges since the previous stats() call (the loop
+        calls it at log boundaries, same cadence as the engine's)."""
+        now = self._time()
+        with self._lock:
+            dt = max(now - self._last_stats, 1e-9)
+            rate = self._bytes / dt
+            overlap = (max(0.0, 1.0 - self._wait_sec / self._transfer_sec)
+                       if self._transfer_sec > 0 else 0.0)
+            self._bytes = 0
+            self._transfer_sec = 0.0
+            self._wait_sec = 0.0
+            self._last_stats = now
+        return {"h2d_bytes_per_sec": round(rate, 1),
+                "h2d_overlap_frac": round(min(overlap, 1.0), 6)}
 
 
 def staged_device_prefetch(host_iter: Iterator[Batch], stage_sharding,

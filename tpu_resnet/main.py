@@ -138,6 +138,12 @@ def main(argv=None):
                            help="perf-regression verdict over the "
                                 "archived BENCH_*.json trajectory "
                                 "(tools/perfwatch.py)")
+            p.add_argument("--sweep-probe", action="store_true",
+                           help="~30s scrubbed-CPU drill of the per-knob "
+                                "sweep harness: 2-point sweep end-to-end "
+                                "— child deadlines honored, complete "
+                                "RESULT_JSON trajectory, perfwatch "
+                                "ingestion")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -158,7 +164,8 @@ def main(argv=None):
                              check=args.check,
                              serve_probe=args.serve_probe,
                              trace_probe=args.trace_probe,
-                             perfwatch=args.perfwatch)
+                             perfwatch=args.perfwatch,
+                             sweep_probe=args.sweep_probe)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

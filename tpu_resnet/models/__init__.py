@@ -31,6 +31,10 @@ def build_model(cfg):
                    image_size=cfg.data.resolved_image_size)
     if cfg.model.name != "resnet":
         raise ValueError(f"unknown model {cfg.model.name!r}")
+    epilogue = getattr(cfg.model, "fused_epilogue", "off")
+    if epilogue not in ("off", "on", "auto"):
+        raise ValueError(f"model.fused_epilogue must be off|on|auto, "
+                         f"got {epilogue!r}")
     if cfg.data.dataset == "imagenet":
         # fused_blocks: bottleneck sizes dispatch to the halo-tiled
         # kernel family (FusedBottleneckBlock; f=512 blocks stay XLA);
@@ -40,7 +44,8 @@ def build_model(cfg):
         return imagenet_resnet_v2(
             cfg.model.resnet_size, cfg.data.num_classes, dtype=dtype,
             stem_space_to_depth=cfg.model.stem_space_to_depth,
-            remat=cfg.model.remat, fused_blocks=cfg.model.fused_blocks)
+            remat=cfg.model.remat, fused_blocks=cfg.model.fused_blocks,
+            fused_epilogue=epilogue)
     if cfg.model.fused_blocks and cfg.model.width_multiplier > 1:
         # Wide-ResNet channels (160/320/640 at WRN-28-10) put the default
         # tile far past core VMEM, and no A/B has measured those shapes —
@@ -51,4 +56,5 @@ def build_model(cfg):
                            width_multiplier=cfg.model.width_multiplier,
                            dtype=dtype, remat=cfg.model.remat,
                            fused_blocks=cfg.model.fused_blocks,
-                           fused_block_tile=cfg.model.fused_block_tile)
+                           fused_block_tile=cfg.model.fused_block_tile,
+                           fused_epilogue=epilogue)

@@ -42,6 +42,7 @@ from __future__ import annotations
 from typing import Any, Optional, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 Dtype = Any
@@ -55,23 +56,68 @@ dense_kernel_init = nn.initializers.xavier_uniform()
 
 
 class BatchNormRelu(nn.Module):
-    """BN (fp32 stats/params) then ReLU, computing in ``dtype``."""
+    """BN (fp32 stats/params) then ReLU, computing in ``dtype``.
+
+    ``epilogue`` != "off" executes the site as the fused Pallas conv
+    epilogue (tpu_resnet/ops/epilogue.py): batch/running moments are
+    folded to a scale/bias affine (one XLA reduction in training; free
+    at eval) and the scale-bias-ReLU chain runs as ONE VMEM pass over
+    the conv output. The parameter/stat tree is IDENTICAL to
+    nn.BatchNorm (same paths/shapes/inits via _BNVars), so checkpoints
+    interchange and ``model.fused_epilogue`` can flip on a restore.
+    "auto" consults the compile-time A/B cache (ops/autotune.py) per
+    shape — unprofitable shapes keep the identical XLA math."""
 
     dtype: Dtype = jnp.float32
     axis_name: Optional[str] = None
+    epilogue: str = "off"
 
     @nn.compact
     def __call__(self, x, *, train: bool):
-        x = nn.BatchNorm(
-            use_running_average=not train,
-            momentum=_BATCH_NORM_MOMENTUM,
-            epsilon=_BATCH_NORM_EPSILON,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-            axis_name=self.axis_name if train else None,
-            name="bn",
-        )(x)
-        return nn.relu(x)
+        if self.epilogue == "off":
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=_BATCH_NORM_MOMENTUM,
+                epsilon=_BATCH_NORM_EPSILON,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                axis_name=self.axis_name if train else None,
+                name="bn",
+            )(x)
+            return nn.relu(x)
+        if self.epilogue not in ("on", "auto"):
+            raise ValueError(f"fused_epilogue must be off|on|auto, got "
+                             f"{self.epilogue!r}")
+        if self.axis_name is not None:
+            raise ValueError("fused_epilogue does not implement sync-BN "
+                             "(bn_axis_name); unset one of the two")
+        from tpu_resnet.ops import autotune
+        from tpu_resnet.ops import epilogue as ep
+
+        gamma, beta, ra_mean, ra_var = _BNVars(x.shape[-1], name="bn")()
+        if train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            # Fast (single-pass) variance, matching flax BatchNorm's
+            # use_fast_variance=True; clamped so rsqrt can't NaN under
+            # fp32 cancellation.
+            var = jnp.maximum(
+                jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+                - jnp.square(mean), 0.0)
+            if not self.is_initializing():
+                m = _BATCH_NORM_MOMENTUM  # flax EMA convention
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        scale = gamma * jax.lax.rsqrt(var + _BATCH_NORM_EPSILON)
+        bias = beta - mean * scale
+        use_kernel = (self.epilogue == "on"
+                      or autotune.use_pallas(ep.OP_SBR,
+                                             ep.sbr_key(x.shape)))
+        if use_kernel:
+            return ep.scale_bias_relu(x, scale, bias)
+        return ep.scale_bias_relu_reference(x, scale, bias)
 
 
 class ConvFixedPadding(nn.Module):
@@ -304,6 +350,16 @@ def _check_fused_bn_axis(fused_blocks: bool, bn_axis_name) -> None:
                          "(bn_axis_name); unset one of the two")
 
 
+def _check_epilogue_bn_axis(fused_epilogue: str, bn_axis_name) -> None:
+    """Same fail-loud convention for the fused BN+ReLU epilogues: the
+    manual-moments epilogue path computes batch statistics per replica
+    with no cross-device axis sync — sync-BN via ``bn_axis_name`` must
+    raise, not silently degrade (mirrors _check_fused_bn_axis)."""
+    if fused_epilogue != "off" and bn_axis_name is not None:
+        raise ValueError("fused_epilogue does not implement sync-BN "
+                         "(bn_axis_name); unset one of the two")
+
+
 class FusedBottleneckBlock(nn.Module):
     """BottleneckBlock (stride 1, identity shortcut) executed as the
     halo-tiled fused Pallas bottleneck kernel family
@@ -373,12 +429,13 @@ class BuildingBlock(nn.Module):
     use_projection: bool
     dtype: Dtype = jnp.float32
     bn_axis_name: Optional[str] = None
+    epilogue: str = "off"
 
     @nn.compact
     def __call__(self, x, train: bool):
         shortcut = x
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
-            x, train=train)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, self.epilogue,
+                          name="preact")(x, train=train)
         if self.use_projection:
             # Projection comes after the first BN+ReLU: it convolves the
             # pre-activated input (resnet_model_official.py:117-120).
@@ -386,8 +443,8 @@ class BuildingBlock(nn.Module):
                 self.filters, 1, self.strides, self.dtype, name="proj")(x)
         x = ConvFixedPadding(
             self.filters, 3, self.strides, self.dtype, name="conv1")(x)
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu1")(
-            x, train=train)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, self.epilogue,
+                          name="bnrelu1")(x, train=train)
         x = ConvFixedPadding(self.filters, 3, 1, self.dtype, name="conv2")(x)
         return x + shortcut
 
@@ -401,22 +458,23 @@ class BottleneckBlock(nn.Module):
     use_projection: bool
     dtype: Dtype = jnp.float32
     bn_axis_name: Optional[str] = None
+    epilogue: str = "off"
 
     @nn.compact
     def __call__(self, x, train: bool):
         shortcut = x
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="preact")(
-            x, train=train)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, self.epilogue,
+                          name="preact")(x, train=train)
         if self.use_projection:
             shortcut = ConvFixedPadding(
                 4 * self.filters, 1, self.strides, self.dtype, name="proj")(x)
         x = ConvFixedPadding(self.filters, 1, 1, self.dtype, name="conv1")(x)
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu1")(
-            x, train=train)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, self.epilogue,
+                          name="bnrelu1")(x, train=train)
         x = ConvFixedPadding(
             self.filters, 3, self.strides, self.dtype, name="conv2")(x)
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="bnrelu2")(
-            x, train=train)
+        x = BatchNormRelu(self.dtype, self.bn_axis_name, self.epilogue,
+                          name="bnrelu2")(x, train=train)
         x = ConvFixedPadding(4 * self.filters, 1, 1, self.dtype, name="conv3")(x)
         return x + shortcut
 
@@ -437,6 +495,9 @@ class BlockLayer(nn.Module):
     # on the XLA path; see FusedBuildingBlock). Basic blocks only.
     fused: bool = False
     fused_tile: int = 16
+    # Fused Pallas BN+ReLU epilogues at the XLA-path BN sites
+    # (ops/epilogue.py; off | on | auto — see BatchNormRelu).
+    epilogue: str = "off"
 
     @nn.compact
     def __call__(self, x, *, train: bool):
@@ -472,8 +533,10 @@ class BlockLayer(nn.Module):
             except ValueError:
                 fuse = False   # no VMEM plan at this width: stay on XLA
         _check_fused_bn_axis(fuse, self.bn_axis_name)
+        _check_epilogue_bn_axis(self.epilogue, self.bn_axis_name)
         x = block_cls(self.filters, self.strides, True, self.dtype,
-                      self.bn_axis_name, name="block0")(x, train)
+                      self.bn_axis_name, self.epilogue,
+                      name="block0")(x, train)
         for i in range(1, self.blocks):
             if fuse and self.bottleneck:
                 x = fused_cls(self.filters, self.dtype,
@@ -483,7 +546,8 @@ class BlockLayer(nn.Module):
                               name=f"block{i}")(x, train)
             else:
                 x = block_cls(self.filters, 1, False, self.dtype,
-                              self.bn_axis_name, name=f"block{i}")(x, train)
+                              self.bn_axis_name, self.epilogue,
+                              name=f"block{i}")(x, train)
         return x
 
 
@@ -516,6 +580,11 @@ class ResNetV2(nn.Module):
     # gated on battery stage 05_fused_block_ab's A/B.
     fused_blocks: bool = False
     fused_block_tile: int = 16
+    # Fused Pallas BN+ReLU epilogues at every XLA-path BN site
+    # (ops/epilogue.py; off | on | auto — "auto" takes the per-shape
+    # compile-time A/B cache). Off by default: flips per shape on a
+    # measured win, the xent-kernel policy.
+    fused_epilogue: str = "off"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -540,9 +609,11 @@ class ResNetV2(nn.Module):
             x = BlockLayer(f, b, s, self.bottleneck, self.dtype,
                            self.bn_axis_name, self.remat,
                            self.fused_blocks, self.fused_block_tile,
+                           self.fused_epilogue,
                            name=f"block_layer{i + 1}")(x, train=train)
 
-        x = BatchNormRelu(self.dtype, self.bn_axis_name, name="final_bnrelu")(
+        x = BatchNormRelu(self.dtype, self.bn_axis_name,
+                          self.fused_epilogue, name="final_bnrelu")(
             x, train=train)
         # Global spatial mean == the reference's full-extent VALID avg-pool
         # (resnet_model_official.py:269-274, :337-344).
@@ -559,7 +630,8 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
                     bn_axis_name: Optional[str] = None,
                     remat: bool = False,
                     fused_blocks: bool = False,
-                    fused_block_tile: int = 16) -> ResNetV2:
+                    fused_block_tile: int = 16,
+                    fused_epilogue: str = "off") -> ResNetV2:
     """6n+2 CIFAR ResNet-v2 (reference resnet_model_official.py:217-278).
 
     'ResNet-50' on CIFAR means n=8 basic blocks per stage with filters
@@ -584,6 +656,7 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
         raise ValueError("fused_blocks is only measured/tiled for "
                          "width_multiplier=1 (16/32/64-channel stages)")
     _check_fused_bn_axis(fused_blocks, bn_axis_name)
+    _check_epilogue_bn_axis(fused_epilogue, bn_axis_name)
     w = width_multiplier
     return ResNetV2(
         stage_filters=(16 * w, 32 * w, 64 * w),
@@ -598,6 +671,7 @@ def cifar_resnet_v2(resnet_size: int, num_classes: int,
         remat=remat,
         fused_blocks=fused_blocks,
         fused_block_tile=fused_block_tile,
+        fused_epilogue=fused_epilogue,
     )
 
 
@@ -617,7 +691,8 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
                        bn_axis_name: Optional[str] = None,
                        stem_space_to_depth: bool = True,
                        remat: bool = False,
-                       fused_blocks: bool = False) -> ResNetV2:
+                       fused_blocks: bool = False,
+                       fused_epilogue: str = "off") -> ResNetV2:
     """ImageNet ResNet-v2 18/34/50/101/152/200
     (reference resnet_model_official.py:350-366)."""
     if resnet_size not in _IMAGENET_PARAMS:
@@ -625,6 +700,7 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
             f"invalid resnet_size {resnet_size}; have {sorted(_IMAGENET_PARAMS)}")
     bottleneck, blocks = _IMAGENET_PARAMS[resnet_size]
     _check_fused_bn_axis(fused_blocks, bn_axis_name)
+    _check_epilogue_bn_axis(fused_epilogue, bn_axis_name)
     return ResNetV2(
         stage_filters=(64, 128, 256, 512),
         stage_blocks=blocks,
@@ -638,4 +714,5 @@ def imagenet_resnet_v2(resnet_size: int, num_classes: int,
         stem_space_to_depth=stem_space_to_depth,
         remat=remat,
         fused_blocks=fused_blocks,
+        fused_epilogue=fused_epilogue,
     )

@@ -59,6 +59,15 @@ CORE_GAUGES = (
     ("data_ring_slots", "Total engine ring slots"),
     ("data_decode_images_per_sec",
      "Host decode throughput over the last interval"),
+    # Double-buffered H2D prefetch (data/pipeline.py::DoubleBufferedH2D):
+    # the staged superbatch transfer rate and how much of it hid under
+    # compute. overlap ~0 with data_wait high = link-bound; ~1 = the
+    # transfer is free (docs/PERF.md tuning playbook).
+    ("h2d_bytes_per_sec",
+     "Host->device staged transfer rate over the last interval"),
+    ("h2d_overlap_frac",
+     "Fraction of H2D transfer wall time overlapped with dispatch "
+     "(0..1)"),
     ("compile_seconds", "First-dispatch wall time (trace+compile+run)"),
     ("checkpoint_lag_steps", "Steps since the last checkpoint save"),
     # MFU accounting (tpu_resnet/obs/mfu.py): achieved model FLOP/s and

@@ -54,6 +54,12 @@ _FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3}
 _TID_SPANS = {"train": 1, "eval": 11, "serve": 21}
 _TID_BREAKDOWN = 2
 _TID_ENGINE = 3
+# Dedicated transfer lane: h2d_transfer spans (the double-buffered
+# staged superbatch copies, data/pipeline.py::DoubleBufferedH2D) render
+# on their own thread so the overlap with the train/compile spans above
+# is visible at a glance in Perfetto.
+_TID_H2D = 4
+_H2D_SPAN = "h2d_transfer"
 
 # Counter series lifted from metrics.jsonl records onto counter threads:
 # (record key, counter thread, counter name).
@@ -65,6 +71,8 @@ _COUNTER_KEYS = (
     ("data_ring_occupancy", _TID_ENGINE, "data_ring_occupancy"),
     ("data_decode_images_per_sec", _TID_ENGINE,
      "data_decode_images_per_sec"),
+    ("h2d_bytes_per_sec", _TID_H2D, "h2d_bytes_per_sec"),
+    ("h2d_overlap_frac", _TID_H2D, "h2d_overlap_frac"),
 )
 
 _INTERVAL_ARG_KEYS = (
@@ -73,7 +81,8 @@ _INTERVAL_ARG_KEYS = (
     "device_sync_sec", "device_step_sec_sampled", "compile_seconds",
     "model_flops_per_sec", "mfu", "train_step_ms_p50", "train_step_ms_p95",
     "train_step_ms_p99", "data_ring_occupancy",
-    "data_decode_images_per_sec",
+    "data_decode_images_per_sec", "h2d_bytes_per_sec",
+    "h2d_overlap_frac",
 )
 
 
@@ -87,7 +96,6 @@ def _span_events(spans: List[dict], source: str, base: float,
                  pid_of: Dict[str, int]) -> List[dict]:
     events = []
     pid = pid_of[source]
-    tid = _TID_SPANS[source]
     for s in spans:
         try:
             start, end = float(s["start"]), float(s["end"])
@@ -95,9 +103,12 @@ def _span_events(spans: List[dict], source: str, base: float,
             continue
         if end < start:
             continue
+        name = str(s.get("span", "span"))
+        tid = (_TID_H2D if source == "train" and name == _H2D_SPAN
+               else _TID_SPANS[source])
         args = {k: v for k, v in s.items()
                 if k not in ("span", "start", "end", "pid")}
-        common = {"name": str(s.get("span", "span")), "cat": source,
+        common = {"name": name, "cat": source,
                   "pid": pid, "tid": tid, "ts": _us(start, base),
                   "args": args}
         if end == start:
@@ -213,6 +224,10 @@ def build_trace(train_dir: str) -> dict:
                             label=f"{labels[src]}{suffix}"))
         events.append(_meta("thread_name", pid, _TID_SPANS[src],
                             f"{labels[src]}-spans"))
+        if src == "train" and any(s.get("span") == _H2D_SPAN
+                                  for s in spans):
+            events.append(_meta("thread_name", pid, _TID_H2D,
+                                "h2d-transfer"))
         events.extend(_span_events(spans, src, base, pid_of))
     if metrics:
         pid = pid_of["train"]

@@ -1,6 +1,19 @@
 """Pallas TPU kernels for hot ops. Each op has an interpret-mode path so the
-same kernel code runs (slowly) on CPU in tests."""
+same kernel code runs (slowly) on CPU in tests, and every op's hot-path
+dispatch is guarded by the compile-time A/B probe (ops/autotune.py): a
+Pallas lowering rides only where it measured a win over XLA."""
 
+from tpu_resnet.ops import autotune
+from tpu_resnet.ops.epilogue import (
+    probe_epilogue,
+    probe_model_epilogues,
+    scale_bias_relu,
+    scale_bias_relu_add,
+    scale_bias_relu_add_auto,
+    scale_bias_relu_add_reference,
+    scale_bias_relu_auto,
+    scale_bias_relu_reference,
+)
 from tpu_resnet.ops.fused_block import (
     block_apply,
     block_train_apply,
@@ -10,14 +23,22 @@ from tpu_resnet.ops.fused_block import (
     block_train_fwd_reference,
 )
 from tpu_resnet.ops.softmax_xent import (
+    ensure_xent_probe,
     is_tpu_backend,
     make_pallas_xent,
     softmax_xent_mean,
     softmax_xent_per_example,
+    softmax_xent_reference,
 )
 
-__all__ = ["block_apply", "block_fwd", "block_fwd_reference",
+__all__ = ["autotune",
+           "block_apply", "block_fwd", "block_fwd_reference",
            "block_train_apply",
            "block_train_fwd", "block_train_fwd_reference",
-           "is_tpu_backend", "make_pallas_xent", "softmax_xent_mean",
-           "softmax_xent_per_example"]
+           "ensure_xent_probe", "is_tpu_backend", "make_pallas_xent",
+           "probe_epilogue", "probe_model_epilogues",
+           "scale_bias_relu", "scale_bias_relu_add",
+           "scale_bias_relu_add_auto", "scale_bias_relu_add_reference",
+           "scale_bias_relu_auto", "scale_bias_relu_reference",
+           "softmax_xent_mean", "softmax_xent_per_example",
+           "softmax_xent_reference"]

@@ -62,9 +62,12 @@ except Exception:  # pragma: no cover
 
 from tpu_resnet.ops.softmax_xent import is_tpu_backend
 
-
-def _scale_bias_relu(x, scale, bias):
-    return jnp.maximum(x * scale + bias, 0.0)
+# The epilogue math (scale-bias-ReLU) and the init-or-accumulate grid
+# idiom live with the standalone epilogue kernels (ops/epilogue.py); the
+# block kernels here apply the same epilogue between their convs.
+from tpu_resnet.ops.epilogue import _acc_out  # noqa: F401  (re-exported:
+from tpu_resnet.ops.epilogue import (         # fused_bottleneck imports
+    scale_bias_relu_math as _scale_bias_relu)  # both from this module)
 
 
 def _conv3x3_taps(h_pad, w, bt, h, wdt, c):
@@ -349,21 +352,6 @@ def _recompute_train(x, w1, g1, b1, g2, b2, m1, i1, m2, i2,
     r2 = jnp.maximum(z2, 0.0)
     r2p = jnp.pad(r2, ((0, 0), (1, 1), (1, 1), (0, 0)))
     return z1, z1hat, r1p, z2, z2hat, r2p
-
-
-def _acc_out(first, refs, vals):
-    """Init-or-accumulate outputs across a sequential grid; ``first`` is
-    the predicate marking the first grid step (a bool so 2-D grids — the
-    bottleneck kernels — can use it too)."""
-    @pl.when(first)
-    def _init():
-        for ref, v in zip(refs, vals):
-            ref[...] = v
-
-    @pl.when(jnp.logical_not(first))
-    def _acc():
-        for ref, v in zip(refs, vals):
-            ref[...] += v
 
 
 def _train_bwd_calls(x, gy, w1, w2, g1, b1, g2, b2, moments, eps, *,

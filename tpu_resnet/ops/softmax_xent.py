@@ -17,6 +17,19 @@ The public entry ``softmax_xent_mean`` pads C up to a lane multiple (128)
 with -1e30 and B up to the batch tile, masking padded rows, so callers can
 use any (B, C). ``interpret=True`` (auto on non-TPU backends) runs the same
 kernel under the Pallas interpreter for CPU tests.
+
+Block-spec retune (MFU campaign; BENCH_r04 measured this kernel at
+0.901x of XLA at b128x1000 — a live regression): the forward previously
+wrote the per-example loss broadcast across the FULL padded class dim
+([B, C] fp32 to HBM — 512 KB of redundant writes per b128x1024 tile)
+and the backward materialized the upstream cotangent broadcast to
+[B, C] as a kernel INPUT. Both now move one 128-lane tile instead
+([B, 128]), cutting that traffic C/128-fold at ImageNet head shapes,
+and the batch tile is shape-aware (``default_batch_tile``). The kernel
+still must EARN the hot path per shape: ``ensure_xent_probe`` runs the
+compile-time A/B (tpu_resnet/ops/autotune.py) and the train step's
+default ``optim.use_pallas_xent="auto"`` dispatches to whichever arm
+measured faster — an unprofitable shape auto-falls back to XLA.
 """
 
 from __future__ import annotations
@@ -66,8 +79,10 @@ def _fwd_kernel(logits_ref, labels_ref, loss_ref):
     classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     label_logit = jnp.sum(jnp.where(classes == lab, x, 0.0), axis=1,
                           keepdims=True)
-    # Broadcast per-example loss across the lane dim; caller slices [:, 0].
-    loss_ref[:] = jnp.broadcast_to(lse - label_logit, x.shape)
+    # Per-example loss broadcast across ONE 128-lane tile (not the full
+    # padded class dim — the b128x1000 retune); caller slices [:, 0].
+    loss_ref[:] = jnp.broadcast_to(lse - label_logit,
+                                   (x.shape[0], _LANE))
 
 
 def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref):
@@ -90,8 +105,8 @@ def _pallas_per_example(logits, labels, batch_tile, interpret):
         grid=grid,
         in_specs=[_block_spec((batch_tile, c)),
                   _block_spec((batch_tile, 1))],
-        out_specs=_block_spec((batch_tile, c)),
-        out_shape=jax.ShapeDtypeStruct((b, c), jnp.float32),
+        out_specs=_block_spec((batch_tile, _LANE)),
+        out_shape=jax.ShapeDtypeStruct((b, _LANE), jnp.float32),
         interpret=interpret,
     )(logits, labels)
     return out[:, 0]
@@ -100,17 +115,32 @@ def _pallas_per_example(logits, labels, batch_tile, interpret):
 def _pallas_bwd(logits, labels, g, batch_tile, interpret):
     b, c = logits.shape
     grid = (b // batch_tile,)
-    g2d = jnp.broadcast_to(g[:, None], (b, c)).astype(jnp.float32)
+    # Upstream cotangent as ONE lane tile, not a materialized [B, C]
+    # broadcast input (the other half of the b128x1000 retune).
+    g2d = jnp.broadcast_to(g[:, None], (b, _LANE)).astype(jnp.float32)
     return pl.pallas_call(
         _bwd_kernel,
         grid=grid,
         in_specs=[_block_spec((batch_tile, c)),
                   _block_spec((batch_tile, 1)),
-                  _block_spec((batch_tile, c))],
+                  _block_spec((batch_tile, _LANE))],
         out_specs=_block_spec((batch_tile, c)),
         out_shape=jax.ShapeDtypeStruct((b, c), logits.dtype),
         interpret=interpret,
     )(logits, labels, g2d)
+
+
+_TILE_BUDGET = 4 * 2 ** 20
+
+
+def default_batch_tile(b: int, c_padded: int,
+                       budget: int = _TILE_BUDGET) -> int:
+    """Shape-aware batch tile: the kernels hold ~2 fp32 copies of the
+    [bt, C] logits block live in VMEM; keep that inside the plan budget
+    while preferring a single grid step when the whole batch fits (it
+    does at every ResNet head shape — b128x1024 is 1 MB)."""
+    per_row = 2 * c_padded * 4
+    return max(8, min(b, budget // max(per_row, 1)))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -144,7 +174,8 @@ def softmax_xent_per_example(logits: jnp.ndarray, labels: jnp.ndarray,
         interpret = not _is_tpu()
     b, c = logits.shape
     c_pad = (-c) % _LANE
-    b_tile = min(batch_tile, max(8, b))
+    b_tile = min(batch_tile, max(8, b),
+                 default_batch_tile(b, c + c_pad))
     b_pad = (-b) % b_tile
     x = logits.astype(jnp.float32)
     if c_pad:
@@ -162,6 +193,48 @@ def softmax_xent_mean(logits: jnp.ndarray, labels: jnp.ndarray,
     train step (tpu_resnet/train/step.py softmax_xent)."""
     return jnp.mean(softmax_xent_per_example(logits, labels,
                                              interpret=interpret))
+
+
+def softmax_xent_reference(logits: jnp.ndarray,
+                           labels: jnp.ndarray) -> jnp.ndarray:
+    """The XLA arm of the A/B: mean xent via the plain logsumexp/one-hot
+    chain — the same math optax's softmax_cross_entropy lowers to (the
+    train step's default path)."""
+    x = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(x, axis=-1)
+    label_logit = jnp.take_along_axis(
+        x, labels.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+    return jnp.mean(lse - label_logit)
+
+
+OP_XENT = "xent"
+
+
+def ensure_xent_probe(batch: int, classes: int, dtype=jnp.float32,
+                      iters: int = 100, interpret: bool | None = None):
+    """Compile-time A/B of the Pallas xent vs XLA at one (B, C) head
+    shape — grad through the mean loss, the training hot path. Cached
+    per shape (tpu_resnet/ops/autotune.py); the first call pays two
+    small compiles, charged to the caller's setup/compile window.
+    Returns the Decision."""
+    from tpu_resnet.ops import autotune
+
+    key = autotune.shape_key(batch, classes)
+    existing = autotune.decision(OP_XENT, key)
+    if existing is not None:
+        return existing
+    logits = jax.random.normal(jax.random.PRNGKey(classes),
+                               (batch, classes), dtype)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0,
+                                classes)
+    return autotune.probe(
+        OP_XENT, key,
+        lambda x, lab: jax.grad(
+            lambda a: softmax_xent_mean(a, lab, interpret=interpret)
+        )(x),
+        lambda x, lab: jax.grad(
+            lambda a: softmax_xent_reference(a, lab))(x),
+        (logits, labels), iters=iters)
 
 
 def make_pallas_xent(mesh=None):

@@ -51,6 +51,12 @@ Checks:
   perfwatch  optional (--perfwatch): perf-regression verdict over the
              archived BENCH_*.json trajectory (tools/perfwatch.py) —
              fails only on a regress verdict outside the noise band
+  sweep_probe  optional (--sweep-probe): ~30 s scrubbed-CPU drill of the
+             per-knob sweep harness (tpu_resnet/tools/sweep.py): a
+             2-point sweep end-to-end — child deadlines honored, the
+             RESULT_JSON trajectory complete and parseable, and
+             perfwatch able to cohort it — so the MFU-campaign rig
+             can't silently rot between chip windows
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -532,6 +538,83 @@ def _check_perfwatch() -> dict:
         return out
 
 
+def _check_sweep_probe(timeout: int = 300) -> dict:
+    """~30 s scrubbed-CPU drill of the per-knob sweep harness
+    (tpu_resnet/tools/sweep.py): a 2-point MLP sweep runs end-to-end —
+    every child under the BENCH_CHILD_DEADLINE contract (each ok point
+    must report a positive ``deadline_margin_sec``), the final
+    RESULT_JSON trajectory is COMPLETE (every declared point has a
+    status; a lost point is the BENCH_r04 failure mode), and
+    ``tools/perfwatch.py --sweep`` must ingest the artifact. Proves the
+    sweep rig on this machine before a chip campaign bets on it."""
+    import tempfile
+
+    from tpu_resnet.hostenv import scrubbed_cpu_env
+
+    space = {"transfer_stage": [1, 2], "donate": [True], "prefetch": [2],
+             "h2d": [True], "batch": [16], "xla_flags": [""],
+             "fused": [False], "remat": [False]}
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_sweep_") as d:
+        out_json = os.path.join(d, "sweep.json")
+        cmd = [sys.executable, "-m", "tpu_resnet.tools.sweep",
+               "--space", json.dumps(space), "--model", "mlp",
+               "--split", "256", "--warmup", "1", "--measure", "4",
+               "--out", os.path.join(d, "points.jsonl"),
+               "--json", out_json, "--budget", str(timeout - 60),
+               "--point-timeout", "120", "--point-est", "10"]
+        try:
+            proc = subprocess.run(cmd, env=scrubbed_cpu_env(2), cwd=d,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True,
+                                  timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return {"ok": False, "error": f"sweep hung for {timeout}s"}
+        try:
+            with open(out_json) as f:
+                trajectory = json.load(f)
+        except (OSError, ValueError):
+            return {"ok": False, "rc": proc.returncode,
+                    "error": "no trajectory JSON written",
+                    "tail": proc.stdout.strip().splitlines()[-5:]}
+        points = {p.get("id"): p for p in trajectory.get("points", [])}
+        complete = set(points) == {"base", "transfer_stage=2"}
+        all_ok = all(p.get("status") == "ok" for p in points.values())
+        deadline_honored = all(
+            p.get("deadline_margin_sec", -1) > 0 for p in points.values()
+            if p.get("status") == "ok")
+        out = {"ok": bool(complete and all_ok and deadline_honored),
+               "rc": proc.returncode, "complete": complete,
+               "statuses": {k: p.get("status")
+                            for k, p in points.items()},
+               "deadline_honored": deadline_honored}
+        # perfwatch must be able to cohort the artifact (the satellite
+        # contract: sweep output round-trips through the regression
+        # tracker). Skipped on an installed wheel without tools/.
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        script = os.path.join(root, "tools", "perfwatch.py")
+        if os.path.exists(script):
+            try:
+                pw = subprocess.run(
+                    [sys.executable, script, "--sweep", out_json],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, timeout=60)
+            except subprocess.TimeoutExpired:
+                out.update(ok=False, perfwatch="hung")
+                return out
+            ingested = all(f"sweep:{pid}" in pw.stdout for pid in points)
+            out["perfwatch_ingested"] = ingested
+            out["ok"] = out["ok"] and pw.returncode == 0 and ingested
+            if not ingested:
+                out["perfwatch_tail"] = \
+                    pw.stdout.strip().splitlines()[-5:]
+        else:
+            out["perfwatch_ingested"] = "skipped (no tools/perfwatch.py)"
+        if not out["ok"]:
+            out["tail"] = proc.stdout.strip().splitlines()[-5:]
+        return out
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -577,7 +660,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
-               stream=None) -> dict:
+               sweep_probe: bool = False, stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -619,6 +702,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if perfwatch:
         summary["perfwatch"] = _check_perfwatch()
         emit("perfwatch", summary["perfwatch"])
+    if sweep_probe:
+        summary["sweep_probe"] = _check_sweep_probe()
+        emit("sweep_probe", summary["sweep_probe"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

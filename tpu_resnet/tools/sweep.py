@@ -1,0 +1,571 @@
+"""Per-knob autotune sweep harness — the MFU campaign's measurement rig.
+
+ROADMAP item 3 calls for the throughput levers (XLA flags, donation,
+transfer staging, prefetch depth, fused/remat, batch) to be SWEPT knobs
+with recorded trajectories, not folklore (PAPERS: "Scalable Training of
+Language Models using JAX pjit and TPUv4" treats compiler flags and
+donation exactly this way). This harness:
+
+- declares the knob space explicitly (``DEFAULT_SPACE``; override with
+  ``--space`` JSON) and enumerates it DETERMINISTICALLY — default mode
+  ``axes`` measures a base point plus one-knob-at-a-time deviations
+  (the per-knob sweep); ``--grid`` takes the full cross-product;
+- runs every point as a BUDGETED CHILD process (fresh backend per point
+  — XLA_FLAGS only apply at init), reusing PR 6's
+  ``BENCH_CHILD_DEADLINE`` contract: the child checks the deadline
+  before committing to the measurement and a killed child is recorded
+  as ``skipped_timeout``, a point that no longer fits the overall
+  ``--budget`` as ``skipped_budget`` — the final trajectory is always
+  COMPLETE (every declared point appears with a status; no lost points,
+  the BENCH_r04 failure mode);
+- is RESUMABLE: each finished point appends one line to the ``--out``
+  jsonl; a rerun skips points already measured ``ok`` and re-attempts
+  the rest;
+- emits ONE ``RESULT_JSON:`` trajectory line (plus ``--json`` file)
+  that ``tools/perfwatch.py --sweep`` cohorts by backend and judges
+  point-by-point across runs, so a knob win is reproducible and a knob
+  regression gates.
+
+The parent NEVER imports jax (bench.py discipline — a wedged plugin
+costs a child, not the harness). The measurement child
+(``--point JSON``) builds the production program constructors via
+tpu_resnet/tools/sweep_measure.py (a jit-host-sync lint-scope file) and
+times the streaming input edge end to end.
+
+    python bench.py --sweep                      # default space, this box
+    python -m tpu_resnet.tools.sweep --space '{"transfer_stage": [1, 8]}'
+    python tools/sweep.py --grid --budget 1200   # full cross-product
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import itertools
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+SWEEP_METRIC = "sweep_cifar_stream_steps_per_sec"
+
+# Latency-hiding scheduler + async collectives: the PAPERS-named XLA
+# flag bundle for the chip campaign. NOTE: TPU-only flags abort a CPU
+# child at backend init ("Unknown flags in XLA_FLAGS") — the point is
+# recorded status=error with the tail, never lost; CPU-box demos pass a
+# --space with CPU-valid flags (docs/runs/sweep_cpu_axes_r7.json used
+# --xla_cpu_enable_fast_math=true).
+LATENCY_HIDING_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true "
+    "--xla_tpu_enable_async_all_gather=true "
+    "--xla_tpu_enable_async_collective_permute=true")
+
+# The declared knob space. Knob order (sorted names) and per-knob value
+# order are both part of the deterministic enumeration contract.
+DEFAULT_SPACE: Dict[str, list] = {
+    "xla_flags": ["", LATENCY_HIDING_FLAGS],
+    "donate": [True, False],
+    "transfer_stage": [8, 1, 16],
+    "prefetch": [2, 4],
+    "h2d": [True, False],          # double-buffered H2D vs plain staged
+    "fused": [False, True],        # model.fused_blocks
+    "remat": [False, True],
+    "batch": [128, 256],
+}
+
+
+def _print_line(text: str) -> None:
+    """Single-write line emit (bench.py discipline: a killed emitter
+    leaves a whole line or a truncated one, never a corrupt-parseable
+    one)."""
+    sys.stdout.write(text + "\n")
+    sys.stdout.flush()
+
+
+def _slug(value) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    return re.sub(r"[^A-Za-z0-9_.+=-]+", "_", str(value)).strip("_") or "none"
+
+
+def point_id(knobs: Dict, base: Dict) -> str:
+    """Stable id: 'base' for the base point, else the deviating knobs."""
+    diff = {k: v for k, v in knobs.items() if base.get(k) != v}
+    if not diff:
+        return "base"
+    return ",".join(f"{k}={_slug(v)}" for k, v in sorted(diff.items()))
+
+
+def enumerate_points(space: Dict[str, list], grid: bool = False,
+                     max_points: int = 0) -> List[Dict]:
+    """Deterministic enumeration of the knob space.
+
+    ``axes`` (default): the base point (first value of every knob) plus
+    one point per alternative value of each knob, knobs in sorted-name
+    order — the per-knob sweep. ``grid``: the full cross-product in
+    sorted-name/itertools order. Duplicate knob combinations collapse to
+    their first occurrence, so ids are unique. ``max_points`` truncates
+    (0 = all)."""
+    names = sorted(space)
+    base = {k: space[k][0] for k in names}
+    points: List[Dict] = []
+    seen = set()
+
+    def add(knobs):
+        pid = point_id(knobs, base)
+        if pid in seen:
+            return
+        seen.add(pid)
+        points.append({"id": pid, "knobs": dict(knobs)})
+
+    if grid:
+        for combo in itertools.product(*(space[k] for k in names)):
+            add(dict(zip(names, combo)))
+    else:
+        add(base)
+        for k in names:
+            for v in space[k][1:]:
+                add({**base, k: v})
+    if max_points:
+        points = points[:max_points]
+    return points
+
+
+# --------------------------------------------------------------------------
+# measurement child (imports jax; runs under the parent's deadline)
+# --------------------------------------------------------------------------
+
+def _child_deadline() -> Optional[float]:
+    """Absolute epoch deadline handed down via ``BENCH_CHILD_DEADLINE``
+    (the PR 6 bench-child contract, reused point-for-point here)."""
+    try:
+        return float(os.environ.get("BENCH_CHILD_DEADLINE") or 0) or None
+    except ValueError:
+        return None
+
+
+def _fetch_sync(x) -> float:
+    """Device→host fetch of the result scalar — the only timing barrier
+    this repo trusts (docs/PERF.md retraction: block_until_ready was
+    observed resolving before the compute chain ran)."""
+    import jax
+    import numpy as np
+
+    return float(np.asarray(jax.device_get(x)))
+
+
+def point_config(knobs: Dict, args) -> "object":
+    """RunConfig for one sweep point: the synthetic CIFAR-shaped
+    streaming workload with the point's knobs applied."""
+    from tpu_resnet.config import load_config
+
+    cfg = load_config("smoke")
+    cfg.data.dataset = "synthetic"
+    cfg.data.synthetic_train_examples = args.split
+    cfg.model.name = args.model
+    cfg.model.resnet_size = args.size
+    cfg.model.compute_dtype = args.dtype
+    cfg.model.fused_blocks = bool(knobs.get("fused", False))
+    cfg.model.remat = bool(knobs.get("remat", False))
+    cfg.train.global_batch_size = int(knobs.get("batch", args.batch))
+    cfg.train.seed = 0
+    cfg.data.transfer_stage = int(knobs.get("transfer_stage", 1))
+    cfg.data.prefetch = int(knobs.get("prefetch", 2))
+    cfg.data.h2d_double_buffer = bool(knobs.get("h2d", True))
+    cfg.data.device_resident = "off"
+    return cfg
+
+
+def measure_point(point: Dict, args) -> Dict:
+    """One point's measurement: compile the production programs
+    (sweep_measure.build_point_programs), stream ``--warmup`` +
+    ``--measure`` superbatches through the knob-selected input edge, and
+    report fetch-synced steps/sec plus the step-time breakdown and H2D
+    gauges. Honors the child deadline: if the remaining budget cannot
+    cover compile + measurement, returns ``skipped_budget`` instead of
+    starting work it cannot finish."""
+    deadline = _child_deadline()
+    est = args.point_est
+    if deadline is not None and time.time() + est > deadline:
+        return {"id": point["id"], "knobs": point["knobs"],
+                "status": "skipped_budget",
+                "error": f"child deadline leaves < {est:.0f}s"}
+
+    import jax
+    import numpy as np
+
+    from tpu_resnet import parallel
+    from tpu_resnet.data import pipeline
+    from tpu_resnet.data.cifar import synthetic_data
+    from tpu_resnet.obs import StepBreakdown
+    from tpu_resnet.tools.sweep_measure import build_point_programs
+
+    t_start = time.time()
+    knobs = point["knobs"]
+    cfg = point_config(knobs, args)
+    mesh = parallel.create_mesh(None)
+    parallel.check_divisible(cfg.train.global_batch_size, mesh)
+    state, step_fn, run_staged = build_point_programs(
+        cfg, mesh, donate_state=bool(knobs.get("donate", True)))
+
+    batch = cfg.train.global_batch_size
+    stage = cfg.data.transfer_stage
+    images, labels = synthetic_data(max(args.split, batch), args.image, 10)
+    batcher = pipeline.ShardedBatcher(images, labels.astype(np.int32),
+                                      batch, seed=0, process_index=0,
+                                      process_count=1)
+    host_iter = pipeline.BackgroundIterator(
+        iter(batcher), capacity=max(2, 2 * stage))
+    closers = [host_iter.close]
+    result = {"id": point["id"], "knobs": knobs,
+              "backend": jax.default_backend(),
+              "n_devices": len(jax.devices())}
+    try:
+        bd = StepBreakdown()
+        if stage > 1:
+            sharding = parallel.staged_batch_sharding(mesh)
+            if cfg.data.h2d_double_buffer:
+                it = pipeline.DoubleBufferedH2D(host_iter, sharding,
+                                                stage=stage,
+                                                depth=cfg.data.prefetch)
+                closers.append(it.close)
+            else:
+                it = pipeline.staged_superbatch_prefetch(
+                    host_iter, sharding, stage=stage,
+                    depth=cfg.data.prefetch)
+                closers.append(it.close)
+
+            def run_one():
+                with bd.data_wait():
+                    gi, gl, k = next(it)
+                with bd.dispatch():
+                    out = run_staged(state, gi, gl, 0, k)
+                return out, k
+        else:
+            it = pipeline.device_prefetch(
+                host_iter, parallel.batch_sharding(mesh),
+                depth=cfg.data.prefetch)
+
+            def run_one():
+                with bd.data_wait():
+                    bi, bl = next(it)
+                with bd.dispatch():
+                    out = step_fn(state, bi, bl)
+                return out, 1
+
+        # Deadline-adaptive window (the bench section-skip philosophy,
+        # applied inside a point): on a slow backend the child SHRINKS
+        # the warmup/measure window at superbatch granularity instead of
+        # dying under the parent's kill timeout — a complete, honest
+        # (smaller-n, flagged `truncated`) number beats a lost point.
+        margin = 10.0
+
+        def time_left() -> bool:
+            return deadline is None or time.time() + margin < deadline
+
+        metrics = None
+        warmed = 0
+        tw0 = time.time()
+        for _ in range(args.warmup):
+            (state, metrics), _ = run_one()
+            warmed += 1
+            _fetch_sync(metrics["loss"])
+            if warmed >= 1 and not time_left():
+                break
+        warm_super_sec = (time.time() - tw0) / max(1, warmed)
+        if deadline is not None and \
+                time.time() + warm_super_sec + margin > deadline:
+            # Even ONE measured superbatch would blow the child's kill
+            # timeout (the warmup just measured its cost): report a
+            # parseable skip WITH the evidence instead of being killed
+            # mid-print — the point is recorded, never lost.
+            result.update(status="skipped_budget",
+                          warmup_super_sec=round(warm_super_sec, 1),
+                          error="one superbatch exceeds the remaining "
+                                "child deadline")
+            return result
+        if cfg.data.h2d_double_buffer and hasattr(it, "stats"):
+            it.stats()  # reset the interval so gauges cover the window
+        bd.interval()
+
+        # Deadline checks need the device drained, but a per-STEP sync on
+        # the unstaged path would serialize dispatch and measure command
+        # latency instead of throughput — sync at superbatch granularity
+        # there too (every 8 single-batch steps).
+        sync_every = 1 if stage > 1 else 8
+        t0 = time.perf_counter()
+        measured = supers = 0
+        while supers < args.measure and (supers == 0 or time_left()):
+            (state, metrics), k = run_one()
+            measured += k
+            supers += 1
+            if supers % sync_every == 0:
+                _fetch_sync(metrics["loss"])
+        _fetch_sync(metrics["loss"])
+        dt = time.perf_counter() - t0
+        sps = measured / dt
+        result.update(status="ok", steps_per_sec=round(sps, 3),
+                      images_per_sec=round(sps * batch, 1),
+                      measured_steps=measured,
+                      elapsed_sec=round(time.time() - t_start, 1))
+        if supers < args.measure or warmed < args.warmup:
+            result["truncated"] = True  # deadline shrank the window
+        result.update(bd.interval())
+        if hasattr(it, "stats"):
+            result.update(it.stats())
+        if deadline is not None:
+            result["deadline_margin_sec"] = round(deadline - time.time(), 1)
+    finally:
+        for close in closers:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+    return result
+
+
+# --------------------------------------------------------------------------
+# parent orchestration (never imports jax)
+# --------------------------------------------------------------------------
+
+def _parse_result(out: str) -> Optional[dict]:
+    """Last intact RESULT_JSON line of a child's stdout."""
+    for line in reversed(out.splitlines()):
+        if line.startswith("RESULT_JSON: "):
+            try:
+                return json.loads(line[len("RESULT_JSON: "):])
+            except ValueError:
+                continue
+    return None
+
+
+def _default_runner(cmd, env, timeout):
+    try:
+        proc = subprocess.run(cmd, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              timeout=timeout)
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return 124, out + f"\n[sweep] point timeout after {timeout}s"
+
+
+def load_completed(out_path: str) -> Dict[str, dict]:
+    """Points already measured ``ok`` in a previous run (the resume
+    contract: completed points are skipped, everything else retried)."""
+    done: Dict[str, dict] = {}
+    if not out_path or not os.path.exists(out_path):
+        return done
+    with open(out_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail of a killed run
+            if isinstance(rec, dict) and rec.get("status") == "ok" \
+                    and rec.get("id"):
+                done[rec["id"]] = rec
+    return done
+
+
+def _child_cmd(point: Dict, args) -> List[str]:
+    return [sys.executable, "-m", "tpu_resnet.tools.sweep",
+            "--point", json.dumps(point),
+            "--warmup", str(args.warmup), "--measure", str(args.measure),
+            "--split", str(args.split), "--size", str(args.size),
+            "--image", str(args.image), "--model", args.model,
+            "--dtype", args.dtype, "--batch", str(args.batch),
+            "--point-est", str(args.point_est)]
+
+
+def run_sweep(points: List[Dict], args, runner=None,
+              env: Optional[dict] = None) -> dict:
+    """Measure every point (resumably, under the budget) and return the
+    complete trajectory. ``runner(cmd, env, timeout) -> (rc, stdout)``
+    is injectable for tests."""
+    runner = runner or _default_runner
+    env = dict(os.environ if env is None else env)
+    hard_deadline = time.time() + args.budget if args.budget else None
+    done = load_completed(args.out)
+    out_fh = open(args.out, "a") if args.out else None
+    records: List[dict] = []
+    durations: List[float] = []
+    try:
+        for point in points:
+            if point["id"] in done:
+                rec = dict(done[point["id"]])
+                rec["resumed"] = True
+                records.append(rec)
+                continue
+            est = max(durations) if durations else min(args.point_timeout,
+                                                       args.point_est)
+            if hard_deadline is not None and \
+                    time.time() + est > hard_deadline:
+                records.append({"id": point["id"],
+                                "knobs": point["knobs"],
+                                "status": "skipped_budget",
+                                "error": "sweep --budget exhausted "
+                                         f"(est {est:.0f}s left "
+                                         "insufficient)"})
+                continue
+            child_env = dict(env)
+            # The child resolves `-m tpu_resnet.tools.sweep` regardless
+            # of the caller's cwd (the doctor probe runs from a temp
+            # dir; an installed package needs no help, an in-repo run
+            # gets the checkout root prepended).
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            child_env["PYTHONPATH"] = (
+                root + os.pathsep + child_env["PYTHONPATH"]
+                if child_env.get("PYTHONPATH") else root)
+            flags = str(point["knobs"].get("xla_flags", "") or "")
+            if flags:
+                child_env["XLA_FLAGS"] = (
+                    (child_env.get("XLA_FLAGS", "") + " " + flags).strip())
+            timeout = args.point_timeout
+            if hard_deadline is not None:
+                timeout = max(30, min(timeout,
+                                      int(hard_deadline - time.time())))
+            child_env["BENCH_CHILD_DEADLINE"] = str(
+                time.time() + max(20, timeout - 5))
+            t0 = time.time()
+            rc, out = runner(_child_cmd(point, args), child_env, timeout)
+            dt = time.time() - t0
+            rec = _parse_result(out)
+            if rec is None:
+                status = ("skipped_timeout" if rc == 124 else "error")
+                rec = {"id": point["id"], "knobs": point["knobs"],
+                       "status": status, "rc": rc,
+                       "tail": out.strip().splitlines()[-3:]}
+            else:
+                rec.setdefault("status", "error")
+                rec["rc"] = rc
+                if rc == 124 and rec.get("status") != "ok":
+                    rec["status"] = "skipped_timeout"
+            rec["wall_sec"] = round(dt, 1)
+            if rec.get("status") == "ok":
+                durations.append(dt)
+            records.append(rec)
+            if out_fh is not None:
+                out_fh.write(json.dumps(rec) + "\n")
+                out_fh.flush()
+            print(f"[sweep] {rec['id']}: {rec['status']}"
+                  + (f" {rec['steps_per_sec']} st/s"
+                     if rec.get("status") == "ok" else ""),
+                  file=sys.stderr)
+    finally:
+        if out_fh is not None:
+            out_fh.close()
+
+    ok = [r for r in records if r.get("status") == "ok"]
+    backends = sorted({r.get("backend") for r in ok if r.get("backend")})
+    best = max(ok, key=lambda r: r.get("steps_per_sec", 0.0), default=None)
+    base = next((r for r in records if r["id"] == "base"), None)
+    trajectory = {
+        "metric": SWEEP_METRIC,
+        "sweep": {"mode": "grid" if args.grid else "axes",
+                  "space": {k: list(v) for k, v in args.space.items()}},
+        "backend": backends[0] if backends else "none",
+        "points": records,
+        "completed": len(ok),
+        "skipped": len([r for r in records
+                        if str(r.get("status", "")).startswith("skipped")]),
+        "errors": len([r for r in records if r.get("status") == "error"]),
+    }
+    if best is not None:
+        trajectory["best"] = {"id": best["id"],
+                              "steps_per_sec": best["steps_per_sec"],
+                              "knobs": best["knobs"]}
+        if base is not None and base.get("status") == "ok":
+            trajectory["best"]["vs_base"] = round(
+                best["steps_per_sec"] / base["steps_per_sec"], 3)
+    return trajectory
+
+
+def _load_space(raw: str) -> Dict[str, list]:
+    if not raw:
+        return copy.deepcopy(DEFAULT_SPACE)
+    if os.path.exists(raw):
+        with open(raw) as f:
+            space = json.load(f)
+    else:
+        space = json.loads(raw)
+    if not isinstance(space, dict) or not space or \
+            not all(isinstance(v, list) and v for v in space.values()):
+        raise ValueError("--space must be a JSON object of non-empty "
+                         "knob-value lists")
+    return space
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sweep", description=__doc__.splitlines()[0])
+    ap.add_argument("--point", default="",
+                    help="(child mode) one point as JSON; measures it and "
+                         "emits RESULT_JSON")
+    ap.add_argument("--space", default="",
+                    help="knob space as JSON (inline or a file path); "
+                         "default = DEFAULT_SPACE")
+    ap.add_argument("--grid", action="store_true",
+                    help="full cross-product instead of the per-knob "
+                         "axes walk")
+    ap.add_argument("--max-points", type=int, default=0)
+    ap.add_argument("--out", default="sweep_results.jsonl",
+                    help="per-point jsonl (append; powers resume)")
+    ap.add_argument("--json", default="",
+                    help="also write the final trajectory JSON here")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get("SWEEP_BUDGET", "900")),
+                    help="overall wall budget (s); points that no longer "
+                         "fit are recorded skipped_budget (0 = unbounded)")
+    ap.add_argument("--point-timeout", type=int, default=300,
+                    help="per-point child kill timeout (s)")
+    ap.add_argument("--point-est", type=float, default=60.0,
+                    help="first-point cost estimate for the budget gate "
+                         "(later points use measured durations)")
+    # measurement shape (forwarded to children)
+    ap.add_argument("--warmup", type=int, default=2,
+                    help="warmup superbatches/batches per point")
+    ap.add_argument("--measure", type=int, default=6,
+                    help="measured superbatches/batches per point")
+    ap.add_argument("--split", type=int, default=2048)
+    ap.add_argument("--size", type=int, default=8,
+                    help="resnet_size of the measured model")
+    ap.add_argument("--image", type=int, default=32)
+    ap.add_argument("--model", default="resnet", choices=["resnet", "mlp"])
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--batch", type=int, default=128,
+                    help="base batch when the space has no batch knob")
+    args = ap.parse_args(argv)
+
+    if args.point:
+        point = json.loads(args.point)
+        result = measure_point(point, args)
+        _print_line("RESULT_JSON: " + json.dumps(result))
+        return 0
+
+    args.space = _load_space(args.space)
+    points = enumerate_points(args.space, grid=args.grid,
+                              max_points=args.max_points)
+    print(f"[sweep] {len(points)} points ({'grid' if args.grid else 'axes'}"
+          f" over {len(args.space)} knobs), budget "
+          f"{args.budget or 'unbounded'}s", file=sys.stderr)
+    trajectory = run_sweep(points, args)
+    if args.json:
+        tmp = args.json + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(trajectory, f, indent=1)
+        os.replace(tmp, args.json)
+    _print_line("RESULT_JSON: " + json.dumps(trajectory))
+    # A complete trajectory (every point has a status) is a SUCCESS even
+    # when some points skipped — consumers judge by statuses, not rc.
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Jit-program assembly for the sweep harness (tpu_resnet/tools/sweep.py).
+
+Kept separate from the harness on purpose: everything here is
+jit-reachable program construction — the model, the train step, and the
+two runners a sweep point measures — and the file sits in the static
+jit-host-sync lint scope (tpu_resnet/analysis/jaxlint.py
+JIT_SCOPE_FILES). Host clocks, host RNG, prints and per-call device
+syncs are forbidden here by the linter; the timing loop, subprocess
+plumbing and RESULT_JSON emission live in sweep.py (host code, outside
+the scope).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_resnet import parallel
+from tpu_resnet.data import device_data
+from tpu_resnet.models import build_model
+from tpu_resnet.train import schedule as sched_lib
+from tpu_resnet.train.state import init_state
+from tpu_resnet.train.step import (check_step_config, make_train_step,
+                                   shard_step)
+
+
+def build_point_programs(cfg, mesh, donate_state: bool = True):
+    """Everything one sweep point compiles: the replicated initial state,
+    the per-batch step (``transfer_stage == 1``) and the staged chunk
+    runner (``transfer_stage > 1``) — the exact program constructors
+    train/loop.py uses, so a sweep point measures the production
+    configuration, not a harness approximation.
+
+    Returns ``(state, step_fn, run_staged)``.
+    """
+    check_step_config(cfg, mesh.shape["data"])
+    model = build_model(cfg)
+    schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
+    size = cfg.data.resolved_image_size
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    state = init_state(model, cfg.optim, schedule, rng,
+                       jnp.zeros((1, size, size, 3), jnp.float32))
+    state = jax.device_put(state, parallel.replicated(mesh))
+    base = make_train_step(model, cfg.optim, schedule,
+                           cfg.data.num_classes, None, base_rng=rng,
+                           mesh=mesh,
+                           xent_probe_batch=max(
+                               1, cfg.train.global_batch_size
+                               // mesh.shape["data"]))
+    step_fn = shard_step(base, mesh, donate_state=donate_state)
+    run_staged = device_data.compile_staged_stream_steps(
+        base, mesh, donate_state=donate_state)
+    return state, step_fn, run_staged

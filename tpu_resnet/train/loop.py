@@ -84,6 +84,17 @@ def build_train_iterator(cfg: RunConfig, mesh, start_step: int = 0,
             external_stop=stop_event)
         stream = host_iter
     if stage > 1:
+        if cfg.data.h2d_double_buffer:
+            # Double-buffered H2D (pipeline.DoubleBufferedH2D): a producer
+            # thread assembles + lands the next superbatch transfer while
+            # this thread dispatches the current one; explicit two-slot
+            # device buffer, h2d_* gauges, trace transfer lane. Contents
+            # are identical to the generator form (loss bit-equality
+            # pinned by tests/test_data.py).
+            return pipeline.DoubleBufferedH2D(
+                stream, parallel.staged_batch_sharding(mesh),
+                stage=stage, depth=cfg.data.prefetch,
+                external_stop=stop_event), stage, host_iter
         return pipeline.staged_superbatch_prefetch(
             stream, parallel.staged_batch_sharding(mesh),
             stage=stage, depth=cfg.data.prefetch), stage, host_iter
@@ -186,7 +197,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     # watchdog thread, the telemetry server and the spans file leak
     # into the (in-process) caller.
     rcfg = cfg.resilience
-    shutdown = watchdog = ckpt = tracer = host_iter = None
+    shutdown = watchdog = ckpt = tracer = host_iter = data_iter = None
     m = None
     run_wall0 = None
     step = last_ckpt_step = 0
@@ -228,10 +239,42 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         # Shared with the static config-matrix verifier (analysis/) so a
         # combination it certifies is exactly one this loop accepts.
         check_step_config(cfg, mesh.shape["data"])
+        # Compile-time A/B probes (ops/autotune.py): fused_epilogue="auto"
+        # times the epilogue kernels at this model's stage shapes and
+        # enables Pallas only where it measured a win; the xent "auto"
+        # probe runs inside make_train_step. Host code before the first
+        # dispatch — it rides in the compile window, never a throughput
+        # interval. Failures degrade to the XLA paths, never kill
+        # training.
+        from tpu_resnet import ops
+        if cfg.model.fused_epilogue == "auto" and ops.is_tpu_backend():
+            t_probe = time.time()
+            try:
+                kernel_batch = (cfg.train.global_batch_size
+                                // mesh.shape["data"] if per_replica_bn
+                                else cfg.train.global_batch_size)
+                ops.probe_model_epilogues(cfg, kernel_batch)
+                spans.record("autotune_probe", t_probe, time.time(),
+                             op="epilogue")
+            except Exception as e:  # noqa: BLE001 - probe must not kill
+                log.warning("epilogue autotune probe failed (%s: %s) — "
+                            "all epilogue sites stay on XLA",
+                            type(e).__name__, e)
+        # The xent kernel always sees the PER-DEVICE batch (shard_mapped
+        # over 'data' under auto-jit, the local shard under per-replica
+        # BN, the full batch only on one device) — probe at that shape,
+        # not the global one (b1024-probe/b128-execute would decide at
+        # the wrong point of the speedup curve).
         base_step = make_train_step(model, cfg.optim, schedule,
                                     cfg.data.num_classes, augment_fn,
                                     base_rng=step_rng, mesh=mesh,
-                                    grad_axis="data" if per_replica_bn else None)
+                                    grad_axis="data" if per_replica_bn else None,
+                                    xent_probe_batch=max(
+                                        1, cfg.train.global_batch_size
+                                        // mesh.shape["data"]))
+        if parallel.is_primary() and ops.autotune.decisions():
+            # The run's dispatch choices as a reviewable artifact.
+            ops.autotune.dump(cfg.train.train_dir)
 
         step = int(jax.device_get(state.step))
         total = max_steps if max_steps is not None else cfg.train.train_steps
@@ -416,6 +459,8 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                         # restart it at bad_step so steps (to_step,
                         # bad_step] consume the batches *after* the bad
                         # window instead of replaying it.
+                        if hasattr(data_iter, "close"):
+                            data_iter.close()  # release the H2D producer
                         host_iter.close()
                         data_iter, stage, host_iter = build_train_iterator(
                             cfg, mesh, start_step=bad_step,
@@ -457,6 +502,14 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                     # (0 while the step waits = producer-bound) and the
                     # interval decode rate.
                     m.update(host_iter.stats())
+                if data_iter is not None and hasattr(data_iter, "stats"):
+                    # Double-buffered H2D: interval transfer rate +
+                    # overlap fraction, plus the finished transfers as
+                    # spans for the trace-export transfer lane.
+                    m.update(data_iter.stats())
+                    for t0, t1, nbytes, c in data_iter.drain_transfers():
+                        spans.record("h2d_transfer", t0, t1,
+                                     bytes=nbytes, steps=c)
                 telemetry.update(m)
                 telemetry.set("checkpoint_lag_steps", step - last_ckpt_step)
                 telemetry.heartbeat(step)
@@ -559,6 +612,8 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             _close(server.close)
         if metrics is not None:
             _close(metrics.close)
+        if data_iter is not None and hasattr(data_iter, "close"):
+            _close(data_iter.close)  # H2D producer thread + device slots
         if host_iter is not None:
             _close(host_iter.close)
         if watchdog is not None:

@@ -81,13 +81,21 @@ def check_step_config(cfg, data_axis: int) -> None:
             "model.sync_bn=false (per-replica BN via shard_map — the "
             "reference's BN semantics); global-batch sync-BN is not "
             "implemented for the fused kernels")
+    if (getattr(cfg.model, "fused_epilogue", "off") != "off"
+            and data_axis > 1 and not per_replica_bn):
+        raise ValueError(
+            "model.fused_epilogue on a multi-chip data axis requires "
+            "model.sync_bn=false (per-replica BN via shard_map): the "
+            "epilogue pallas_call cannot be auto-partitioned by the "
+            "sharded jit — same dispatch rule as fused_blocks")
 
 
 def make_train_step(model, optim_cfg, schedule, num_classes: int,
                     augment_fn: Optional[Callable] = None,
                     base_rng: Optional[jax.Array] = None,
                     mesh: Optional[Mesh] = None,
-                    grad_axis: Optional[str] = None):
+                    grad_axis: Optional[str] = None,
+                    xent_probe_batch: int = 128):
     """Returns ``train_step(state, images, labels) -> (state, metrics)``.
 
     ``images`` may be raw uint8 (augment_fn applied on device) or
@@ -105,13 +113,30 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
     if base_rng is None:
         base_rng = jax.random.PRNGKey(0)
 
-    # Opt-in fused Pallas xent (default OFF: the scan-fused v5e A/B measured
-    # 0.90x/0.99x vs XLA's own fusion — config.py use_pallas_xent, docs/
-    # PERF.md); mesh dispatch lives in ops.make_pallas_xent.
-    from tpu_resnet.ops import is_tpu_backend, make_pallas_xent
-    use_pallas = (getattr(optim_cfg, "use_pallas_xent", False)
+    # Fused Pallas xent dispatch (config.py use_pallas_xent, docs/PERF.md):
+    # "auto" (default) runs the compile-time per-shape A/B once at
+    # step-build time (host code, charged to the compile window) and
+    # takes the measured winner — the BENCH_r04 0.901x regression class
+    # auto-falls back to XLA; "on"/"off" force an arm. CPU and
+    # label_smoothing always take the optax chain (program unchanged —
+    # the config-matrix goldens are defined over that trace). Mesh
+    # dispatch lives in ops.make_pallas_xent.
+    from tpu_resnet.ops import (ensure_xent_probe, is_tpu_backend,
+                                make_pallas_xent)
+    mode = str(getattr(optim_cfg, "use_pallas_xent", "off")).lower()
+    mode = {"true": "on", "1": "on", "yes": "on",
+            "false": "off", "0": "off", "no": "off"}.get(mode, mode)
+    if mode not in ("on", "off", "auto"):
+        # Same fail-loud guard as model.fused_epilogue: a typo must not
+        # silently mean "off" while the operator believes the A/B runs.
+        raise ValueError(f"optim.use_pallas_xent must be auto|on|off, "
+                         f"got {optim_cfg.use_pallas_xent!r}")
+    use_pallas = (mode in ("on", "auto")
                   and optim_cfg.label_smoothing == 0.0
                   and is_tpu_backend())
+    if use_pallas and mode == "auto":
+        use_pallas = ensure_xent_probe(xent_probe_batch,
+                                       num_classes).use_pallas
     if use_pallas:
         _pallas_xent = make_pallas_xent(mesh if grad_axis is None else None)
 
