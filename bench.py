@@ -91,6 +91,35 @@ def _peak_flops(device_kind: str):
     return peak_flops_per_chip(device_kind)
 
 
+def _hbm_bytes(device_kind: str):
+    """HBM capacity per chip — the peak-FLOPs table's memory twin
+    (tpu_resnet/obs/memory.py, jax-free import; TPU_RESNET_HBM_BYTES
+    overrides). Lets bench report hbm_utilization next to MFU on chips
+    whose memory_stats() reports usage but no bytes_limit."""
+    from tpu_resnet.obs.memory import hbm_bytes_per_chip
+
+    return hbm_bytes_per_chip(device_kind)
+
+
+def _hbm_snapshot(device_kind: str):
+    """Post-measurement HBM utilization from live device stats
+    (obs/memory.py sample_device_memory): peak bytes vs the reported or
+    table capacity. {} on backends without memory_stats (CPU) — bench
+    lines then simply omit the hbm fields, like mfu without a peak."""
+    from tpu_resnet.obs.memory import sample_device_memory
+
+    sample = sample_device_memory()
+    if not sample:
+        return {}
+    out = {"hbm_bytes_peak": int(sample["hbm_bytes_peak"])}
+    limit = sample.get("hbm_bytes_limit") or _hbm_bytes(device_kind)
+    if limit:
+        out["hbm_bytes_limit"] = int(limit)
+        out["hbm_utilization"] = round(
+            sample["hbm_bytes_peak"] / limit, 4)
+    return out
+
+
 # --------------------------------------------------------------------------
 # measurement children (import jax; run under the parent's timeouts)
 # --------------------------------------------------------------------------
@@ -653,6 +682,10 @@ def run_child(kind: str) -> None:
                 entry["mfu"] = round(
                     entry["flops_per_step_per_device"] * sps / peak, 4)
                 entry["peak_flops_assumed_per_chip"] = peak
+            # HBM twin: peak device memory of the measurement just run
+            # vs capacity — a knob that "wins" MFU by blowing the memory
+            # budget shows it here (and perfwatch gates on it).
+            entry.update(_hbm_snapshot(kinds))
             return entry
 
         if fits("imagenet"):

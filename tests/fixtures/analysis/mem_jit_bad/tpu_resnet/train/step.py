@@ -1,0 +1,23 @@
+"""Seeded jit-host-sync violation (fixture for tests/test_analysis.py):
+device-memory introspection inside the jitted hot path.
+
+obs/memory.py's gauges (device.memory_stats()) and OOM forensics
+(jax.live_arrays()) are host-side log-boundary/crash-handler calls; from
+jit scope memory_stats is a per-dispatch host RPC into the PJRT client
+and live_arrays walks every live buffer. The rule must flag all three
+(memory_analysis is the ledger's compile-introspection marker).
+"""
+import jax
+
+
+def make_train_step(step_fn, state, images, labels):
+    def train_step(state, images, labels):
+        # Per-step memory introspection: all three must be flagged.
+        stats = jax.local_devices()[0].memory_stats()
+        census = jax.live_arrays()
+        budget = step_fn.lower(state, images, labels).compile().memory_analysis()
+        new_state, metrics = step_fn(state, images, labels)
+        metrics["hbm"] = (stats, len(census), budget)
+        return new_state, metrics
+
+    return train_step

@@ -210,6 +210,20 @@ def test_mfu_cost_analysis_in_jit_scope_fixture():
     assert all(f.path == "tpu_resnet/train/step.py" for f in found)
 
 
+def test_memory_introspection_in_jit_scope_fixture():
+    """obs/memory.py's introspection calls (device.memory_stats(),
+    jax.live_arrays(), compiled.memory_analysis()) are log-boundary /
+    crash-handler host costs: the rule flags all three inside jit-scope
+    modules — while the real obs/memory.py (host-side, file pragma with
+    justification) stays clean (covered by test_repo_is_clean)."""
+    found = fixture_findings("mem_jit_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    for hazard in (".memory_stats()", ".live_arrays()",
+                   ".memory_analysis()"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    assert all(f.path == "tpu_resnet/train/step.py" for f in found)
+
+
 def test_serve_signal_fixture():
     """The serve SIGTERM anti-pattern (drain/teardown inline in the
     handler instead of a flag) is in the signal-safety covered set."""

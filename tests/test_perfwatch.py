@@ -150,3 +150,55 @@ def test_verdict_json_output(tmp_path, capsys):
     assert verdict["overall"] == "regress"
     stdout = capsys.readouterr().out
     assert "PERFWATCH_JSON:" in stdout and "regress" in stdout
+
+
+# ------------------------------------------------------- memory gating
+
+def test_bench_hbm_peak_growth_gates_as_regress(tmp_path):
+    """imagenet_hbm_peak_bytes is lower-is-better: a round whose peak
+    HBM grows past the band regresses even while throughput improves —
+    the knob that "wins" MFU by blowing the memory budget."""
+    root = str(tmp_path)
+    for i, (sps, mem) in enumerate([(10.0, 10e9), (10.1, 10.2e9),
+                                    (11.5, 14e9)], start=1):
+        rec = _bench_record(200.0, imagenet_sps=sps, mfu=0.4)
+        rec["imagenet"]["hbm_bytes_peak"] = mem
+        with open(os.path.join(root, f"BENCH_r{i:02d}.json"), "w") as f:
+            json.dump({"n": i, "rc": 0, "parsed": rec, "tail": ""}, f)
+    verdict = perfwatch.judge(perfwatch.load_samples(root), noise=0.08)
+    m = verdict["metrics"]["imagenet_hbm_peak_bytes"]
+    assert m["direction"] == "lower_is_better"
+    assert m["verdict"] == "regress"
+    assert verdict["metrics"]["imagenet_steps_per_sec"]["verdict"] == \
+        "improve"
+    assert verdict["overall"] == "regress"
+    assert perfwatch.main(["--root", root]) == 1
+
+
+def test_sweep_hbm_per_point_gating(tmp_path):
+    """Every sweep point's hbm_bytes_peak becomes a lower-is-better
+    sweep-mem: sample — a memory CUT (the future ZeRO proof) reports
+    improve, growth regresses."""
+    def traj(path, mem):
+        json.dump({"points": [{"id": "p1", "status": "ok",
+                               "steps_per_sec": 100.0,
+                               "hbm_bytes_peak": mem,
+                               "backend": "tpu"}]}, open(path, "w"))
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    traj(a, 10e9)
+    traj(b, 5e9)  # optimizer-state sharding landed: ~2x cut
+    samples = perfwatch.load_sweep_samples([a, b])
+    names = sorted({s["metric"] for s in samples})
+    assert names == ["sweep-mem:p1", "sweep:p1"]
+    verdict = perfwatch.judge(samples, noise=0.08, metric_names=names)
+    verdict = perfwatch.apply_sweep_statuses(
+        verdict, perfwatch.sweep_point_statuses(b))
+    assert verdict["metrics"]["sweep-mem:p1"]["verdict"] == "improve"
+    assert verdict["metrics"]["sweep:p1"]["verdict"] == "flat"
+    traj(b, 14e9)  # and the blown budget gates
+    samples = perfwatch.load_sweep_samples([a, b])
+    verdict = perfwatch.judge(samples, noise=0.08,
+                              metric_names=names)
+    assert verdict["metrics"]["sweep-mem:p1"]["verdict"] == "regress"
+    assert verdict["overall"] == "regress"

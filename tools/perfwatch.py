@@ -58,11 +58,27 @@ def _imagenet_mfu(rec: dict) -> Optional[float]:
     return (rec.get("imagenet") or {}).get("mfu")
 
 
+def _imagenet_hbm_peak(rec: dict) -> Optional[float]:
+    return (rec.get("imagenet") or {}).get("hbm_bytes_peak")
+
+
 METRICS = (
     ("cifar_steps_per_sec", _headline),
     ("imagenet_steps_per_sec", _imagenet_sps),
     ("imagenet_mfu", _imagenet_mfu),
+    ("imagenet_hbm_peak_bytes", _imagenet_hbm_peak),
 )
+
+# Memory metrics invert the verdict: growth past the band is the
+# regression (a knob that "wins" MFU by blowing the HBM budget must not
+# pass silently). Bench records carry hbm_bytes_peak next to mfu
+# (obs/memory.py device stats), sweep points per knob.
+LOWER_IS_BETTER = {"imagenet_hbm_peak_bytes"}
+SWEEP_MEM_PREFIX = "sweep-mem:"
+
+
+def _lower_is_better(name: str) -> bool:
+    return name in LOWER_IS_BETTER or name.startswith(SWEEP_MEM_PREFIX)
 
 
 def salvage_result(text: str) -> Optional[dict]:
@@ -176,10 +192,13 @@ def judge(samples: List[dict], noise: float = 0.08,
             ratio = latest["value"] / ref if ref else float("inf")
             entry.update(reference=round(ref, 6), ratio=round(ratio, 4),
                          noise_band=noise)
+            lower = _lower_is_better(name)
+            if lower:
+                entry["direction"] = "lower_is_better"
             if ratio < 1.0 - noise:
-                entry["verdict"] = "regress"
+                entry["verdict"] = "improve" if lower else "regress"
             elif ratio > 1.0 + noise:
-                entry["verdict"] = "improve"
+                entry["verdict"] = "regress" if lower else "improve"
             else:
                 entry["verdict"] = "flat"
         verdict[name] = entry
@@ -225,7 +244,7 @@ def apply_sweep_statuses(verdict: dict, latest_statuses: Dict[str, str]
     harness's own scheduling (operator shrank the budget), reported as
     ``not_measured`` without gating."""
     for name, entry in verdict["metrics"].items():
-        pid = name[len("sweep:"):]
+        pid = name.split(":", 1)[1] if ":" in name else name
         status = latest_statuses.get(pid)
         if status in (None, "ok"):
             continue
@@ -268,15 +287,25 @@ def load_sweep_samples(paths: List[str]) -> List[dict]:
         for point in rec["points"]:
             if point.get("status") != "ok":
                 continue
+            backend = (point.get("backend") or rec.get("backend")
+                       or "unknown")
             value = point.get("steps_per_sec")
-            if not isinstance(value, (int, float)) or value <= 0:
-                continue
-            samples.append({
-                "source": os.path.basename(path), "order": idx,
-                "metric": f"sweep:{point.get('id')}",
-                "backend": point.get("backend")
-                           or rec.get("backend") or "unknown",
-                "value": float(value), "partial": False})
+            if isinstance(value, (int, float)) and value > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"sweep:{point.get('id')}",
+                    "backend": backend,
+                    "value": float(value), "partial": False})
+            # Peak-HBM twin of the throughput sample (lower-is-better):
+            # judged with the same cohort/noise machinery, so a knob
+            # whose "win" blows the memory budget gates as regress.
+            mem = point.get("hbm_bytes_peak")
+            if isinstance(mem, (int, float)) and mem > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"{SWEEP_MEM_PREFIX}{point.get('id')}",
+                    "backend": backend,
+                    "value": float(mem), "partial": False})
     return samples
 
 

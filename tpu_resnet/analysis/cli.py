@@ -1,8 +1,12 @@
 """CLI for the static-analysis suite.
 
     python -m tpu_resnet check                 # lints + config matrix
+                                               #   + golden memory budgets
     python -m tpu_resnet check --skip-matrix   # lints only (<1s, no jax)
+    python -m tpu_resnet check --skip-memory   # skip the XLA-compile-
+                                               #   backed memory engine
     python -m tpu_resnet check --update-golden # intentional regeneration
+                                               #   (jaxprs AND memory)
     tpu-resnet-check                           # console-script alias
 
 Exit code 0 = clean (after pragmas + baseline), 1 = error findings (or a
@@ -74,13 +78,22 @@ def build_parser() -> argparse.ArgumentParser:
                         f"{sorted(RULES)}")
     p.add_argument("--skip-lint", action="store_true")
     p.add_argument("--skip-matrix", action="store_true",
-                   help="lint only — never imports jax, runs <1s")
+                   help="lint only — never imports jax, runs <1s "
+                        "(also skips the memory-budget engine, which "
+                        "rides on the matrix entries)")
+    p.add_argument("--skip-memory", action="store_true",
+                   help="skip the golden memory-budget engine (it pays "
+                        "real XLA compiles — minutes for the full "
+                        "matrix; the jaxpr trace stays)")
     p.add_argument("--update-golden", action="store_true",
-                   help="rewrite analysis/golden_jaxprs.json from the "
-                        "current programs (intentional program changes; "
-                        "commit the diff and say why)")
+                   help="rewrite analysis/golden_jaxprs.json AND "
+                        "analysis/golden_memory.json from the current "
+                        "programs (intentional program changes; commit "
+                        "the diff and say why)")
     p.add_argument("--golden", default=None,
                    help="alternate golden_jaxprs.json path")
+    p.add_argument("--golden-memory", default=None,
+                   help="alternate golden_memory.json path")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file of accepted findings "
                         "(default: analysis/baseline.json)")
@@ -103,6 +116,10 @@ def main(argv=None) -> int:
               "(configmatrix.py)")
         print("golden-jaxpr-drift compiled-program drift vs "
               "golden_jaxprs.json")
+        print("golden-memory-drift compiled-program HBM budget drift vs "
+              "golden_memory.json (memorybudget.py)")
+        print("memory-budget      memory-budget engine failures "
+              "(entry failed to compile)")
         return 0
 
     root = args.root or _default_root()
@@ -111,7 +128,8 @@ def main(argv=None) -> int:
     # Partial runs (--skip-*/--rules) see only a subset of findings:
     # they can neither judge baseline entries stale nor rewrite the
     # baseline wholesale without deleting the other engines' entries.
-    full_run = not (args.skip_lint or args.skip_matrix or select)
+    full_run = not (args.skip_lint or args.skip_matrix
+                    or args.skip_memory or select)
 
     findings = []
     checked = []
@@ -135,6 +153,23 @@ def main(argv=None) -> int:
         if args.update_golden:
             print(f"updated {len(stats['updated'])} golden entries in "
                   f"{golden_path}")
+        if not args.skip_memory:
+            # Memory budgets ride on the same matrix entries but pay
+            # real XLA compiles (docs/CHECKS.md "golden memory").
+            from tpu_resnet.analysis import memorybudget
+
+            mem_golden = args.golden_memory or memorybudget.GOLDEN_PATH
+            mem_findings, mem_stats = memorybudget.verify_memory(
+                update_golden=args.update_golden, golden_path=mem_golden)
+            findings += mem_findings
+            stats["memory"] = {k: v for k, v in mem_stats.items()
+                               if k != "updated"}
+            checked.append(
+                f"memory: {mem_stats['compiled']} compiled, "
+                f"{mem_stats['compared']} compared")
+            if args.update_golden:
+                print(f"updated {len(mem_stats['updated'])} golden "
+                      f"memory budgets in {mem_golden}")
 
     if args.write_baseline:
         # A partial run MERGES: entries owned by engines/rules that
@@ -146,12 +181,15 @@ def main(argv=None) -> int:
         keep = []
         if not full_run:
             matrix_rules = {"config-matrix", "golden-jaxpr-drift"}
+            memory_rules = {"golden-memory-drift", "memory-budget"}
             lint_rules = (set(select) if select
                           else set(RULES) | {"parse"})
 
             def ran(rule: str) -> bool:
                 if rule in matrix_rules:
                     return not args.skip_matrix
+                if rule in memory_rules:
+                    return not (args.skip_matrix or args.skip_memory)
                 return not args.skip_lint and rule in lint_rules
 
             keep = [e for e in load_baseline(args.baseline)

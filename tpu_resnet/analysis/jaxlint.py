@@ -99,6 +99,17 @@ HOST_SYNC_METHODS = {
     # collide with str.lower/re.compile and are left to review).
     "cost_analysis": "XLA compile introspection (obs/mfu accounting) — "
                      "host-side only, once per program, never per step",
+    # Memory introspection (obs/memory.py): device.memory_stats() is a
+    # host RPC into the PJRT client and jax.live_arrays() walks every
+    # live buffer — both are log-boundary/forensics calls that must
+    # never creep into the jitted hot path. (memory_analysis, like
+    # cost_analysis, only exists on AOT-compiled objects.)
+    "memory_stats": "device-memory introspection (obs/memory gauges) — "
+                    "host-side only, at log boundaries, never per step",
+    "live_arrays": "live-buffer census (obs/memory OOM forensics) — "
+                   "host-side only, crash handlers, never per step",
+    "memory_analysis": "XLA compile introspection (obs/memory ledger) — "
+                       "host-side only, once per program, never per step",
 }
 
 SIGNAL_DENY_PREFIXES = ("subprocess.", "jax.", "jax_", "numpy.",

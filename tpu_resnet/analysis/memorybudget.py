@@ -1,0 +1,249 @@
+"""Golden memory budgets — the HBM twin of the golden-jaxpr verifier.
+
+The config-matrix verifier (configmatrix.py) pins WHAT program each
+supported configuration compiles to; this engine pins what that program
+COSTS in device memory. For every traced matrix entry it compiles the
+real train-step program on a concrete CPU mesh — the same
+``shard_step`` / staged-chunk constructors the loop uses, donation
+included — extracts ``compiled.memory_analysis()`` into a budget
+(argument / output / temp / alias / generated-code bytes) and compares
+it against ``analysis/golden_memory.json`` inside a tolerance band:
+
+- a change that silently doubles temp HBM fails ``tpu-resnet check``
+  exactly like a jaxpr drift (temp is what remat/fusion decisions move);
+- a broken donation collapses ``alias_bytes`` to ~0 — caught as its own
+  named finding, because an undonated state double-buffers every
+  parameter and optimizer slot on every step;
+- the future ZeRO-style optimizer-sharding PR (arXiv:2004.13336) proves
+  its ~N× per-device optimizer-state cut as a reviewable golden diff
+  instead of a claim.
+
+Budgets are defined over the CPU compile (the tier-1/CI environment,
+same rule as the jaxpr goldens): absolute bytes differ on TPU, but the
+*shape* of the budget — donation credit, temp growth, layout changes —
+drifts identically, and CPU is where the merge gate runs. Off-CPU the
+compare is skipped with a warning. Unlike the abstract jaxpr trace this
+engine pays real XLA compiles (~minutes for the full matrix), so the
+CLI exposes ``--skip-memory`` and the tier-1 suite checks a fast subset
+with the full set in the slow tier (docs/CHECKS.md).
+
+Regenerate intentionally with ``python -m tpu_resnet check
+--update-golden`` and say why in the PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from tpu_resnet.analysis.configmatrix import MATRIX, MatrixEntry
+from tpu_resnet.analysis.findings import Finding
+from tpu_resnet.obs.memory import BUDGET_COMPONENTS, budget_from_compiled
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden_memory.json")
+GOLDEN_FORMAT = 1
+# Relative band per component: XLA's buffer assignment is deterministic
+# for a fixed version, but minor releases shuffle temp layouts by a few
+# percent — 10% is wide enough to survive that and far too narrow to
+# hide a doubled temp arena or a dropped donation. Small components also
+# get an absolute slack so a 4 KiB scratch move can't fail a check.
+DEFAULT_TOLERANCE = 0.10
+SLACK_BYTES = 65536
+
+
+def compile_entry_budget(entry: MatrixEntry) -> dict:
+    """Compile the entry's REAL train program on a concrete mesh (the
+    loop's own constructors, donation on) and return its memory budget.
+    Needs ``data_axis * model_axis`` local devices — the caller skips
+    otherwise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_resnet.data import augment as aug_lib
+    from tpu_resnet.data.device_data import make_chunk_fn
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import schedule as sched_lib
+    from tpu_resnet.train.state import init_state
+    from tpu_resnet.train.step import (check_step_config, make_train_step,
+                                       per_replica_shard_map, shard_step)
+
+    cfg = entry.to_config()
+    check_step_config(cfg, entry.data_axis)
+    model = build_model(cfg)
+    schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
+    size = cfg.data.resolved_image_size
+    sample = jnp.zeros((1, size, size, 3), jnp.float32)
+    state_sds = jax.eval_shape(
+        lambda r: init_state(model, cfg.optim, schedule, r, sample),
+        jax.random.PRNGKey(0))
+    n = entry.data_axis * entry.model_axis
+    mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(
+        entry.data_axis, entry.model_axis), ("data", "model"))
+    per_replica = (not cfg.model.sync_bn) and entry.data_axis > 1
+    augment_fn, _ = aug_lib.get_augment_fns(cfg.data.dataset)
+    base = make_train_step(model, cfg.optim, schedule,
+                           cfg.data.num_classes, augment_fn,
+                           base_rng=jax.random.PRNGKey(0), mesh=mesh,
+                           grad_axis="data" if per_replica else None)
+    imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
+    labels = jax.ShapeDtypeStruct((entry.batch,), jnp.int32)
+    if entry.builder == "staged-chunk":
+        # Mirror compile_staged_stream_steps exactly (device_data.py):
+        # the fused chunk program the streaming/double-buffered H2D
+        # input edges dispatch, donation on.
+        chunk = make_chunk_fn(base, entry.chunk_steps)
+        if per_replica:
+            chunk = per_replica_shard_map(
+                chunk, mesh,
+                in_specs=(P(), P(None, "data"), P(None, "data"), P()))
+        jitted = jax.jit(
+            chunk,
+            in_shardings=(NamedSharding(mesh, P()),
+                          NamedSharding(mesh, P(None, "data")),
+                          NamedSharding(mesh, P(None, "data")), None),
+            donate_argnums=(0,))
+        gi = jax.ShapeDtypeStruct(
+            (entry.stage_rows, entry.batch, size, size, 3), jnp.uint8)
+        gl = jax.ShapeDtypeStruct((entry.stage_rows, entry.batch),
+                                  jnp.int32)
+        off = jax.ShapeDtypeStruct((), jnp.int32)
+        compiled = jitted.lower(state_sds, gi, gl, off).compile()
+    else:
+        jitted = shard_step(base, mesh, per_replica_bn=per_replica)
+        compiled = jitted.lower(state_sds, imgs, labels).compile()
+    budget = budget_from_compiled(compiled)
+    if budget is None:
+        raise RuntimeError("backend reported no memory analysis for the "
+                           "compiled program")
+    return budget
+
+
+def _compare(name: str, want: dict, got: dict,
+             tolerance: float) -> List[Finding]:
+    path = f"<golden-memory>/{name}"
+    findings: List[Finding] = []
+    for comp in BUDGET_COMPONENTS:
+        w = int(want.get(comp, 0) or 0)
+        g = int(got.get(comp, 0) or 0)
+        if abs(g - w) <= max(tolerance * max(w, g), SLACK_BYTES):
+            continue
+        if comp == "alias_bytes" and g < w:
+            findings.append(Finding(
+                "golden-memory-drift", path, 0,
+                f"donation-credited (aliased) bytes collapsed "
+                f"{w:,} -> {g:,}: state donation broke for this program "
+                f"— every step now double-buffers the parameters and "
+                f"optimizer slots in HBM. If the donation change is "
+                f"intended, regenerate via `python -m tpu_resnet check "
+                f"--update-golden` and say why in the PR"))
+        else:
+            ratio = g / w if w else float("inf")
+            findings.append(Finding(
+                "golden-memory-drift", path, 0,
+                f"{comp} drifted {w:,} -> {g:,} bytes ({ratio:.2f}x), "
+                f"outside the ±{tolerance:.0%} band — the compiled "
+                f"program's HBM budget changed. If intended (new fusion, "
+                f"remat change, layout work), regenerate via `python -m "
+                f"tpu_resnet check --update-golden` and say why; if not, "
+                f"this is a silent memory regression caught at review "
+                f"time"))
+    return findings
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return {"format": GOLDEN_FORMAT, "entries": {}}
+
+
+def save_golden(golden: dict, path: str = GOLDEN_PATH) -> None:
+    golden["entries"] = dict(sorted(golden["entries"].items()))
+    with open(path, "w") as fh:
+        json.dump(golden, fh, indent=1)
+        fh.write("\n")
+
+
+def verify_memory(entries: Optional[Tuple[MatrixEntry, ...]] = None,
+                  update_golden: bool = False,
+                  golden_path: str = GOLDEN_PATH,
+                  tolerance: Optional[float] = None,
+                  progress=None) -> Tuple[List[Finding], dict]:
+    """Compile every supported matrix entry and verify (or, with
+    ``update_golden``, rewrite) its golden memory budget. Returns
+    ``(findings, stats)``. The compare tolerance is recorded in the
+    golden file so regeneration and verification always agree."""
+    import jax
+
+    entries = MATRIX if entries is None else entries
+    golden = load_golden(golden_path)
+    tol = (tolerance if tolerance is not None
+           else float(golden.get("tolerance", DEFAULT_TOLERANCE)))
+    on_cpu = jax.default_backend() == "cpu"
+    findings: List[Finding] = []
+    stats = {"compiled": 0, "compared": 0, "updated": [],
+             "skipped_devices": 0, "failed": 0}
+
+    if not on_cpu:
+        # Compare AND regeneration are CPU-only: goldens written from a
+        # TPU compile would fail every CI run.
+        findings.append(Finding(
+            "golden-memory-drift", "<golden-memory>", 0,
+            f"golden memory {'update' if update_golden else 'compare'} "
+            f"skipped on backend '{jax.default_backend()}' (budgets are "
+            f"defined over the CPU compile, like the jaxpr goldens)",
+            "warning"))
+        return findings, stats
+
+    for entry in entries:
+        if entry.expect_error is not None or entry.builder == "ctor-bn-axis":
+            continue
+        if progress:
+            progress(entry.name)
+        path = f"<golden-memory>/{entry.name}"
+        need = entry.data_axis * entry.model_axis
+        if len(jax.devices()) < need:
+            stats["skipped_devices"] += 1
+            continue
+        try:
+            budget = compile_entry_budget(entry)
+        except Exception as e:  # one broken entry must not cost the rest
+            stats["failed"] += 1
+            findings.append(Finding(
+                "memory-budget", path, 0,
+                f"supported combination FAILED to compile for its memory "
+                f"budget: {type(e).__name__}: {e}"))
+            continue
+        stats["compiled"] += 1
+        if update_golden:
+            golden["entries"][entry.name] = budget
+            stats["updated"].append(entry.name)
+            continue
+        want = golden["entries"].get(entry.name)
+        if want is None:
+            findings.append(Finding(
+                "golden-memory-drift", path, 0,
+                "no golden memory budget recorded for this entry — run "
+                "`python -m tpu_resnet check --update-golden` and commit "
+                "the regenerated analysis/golden_memory.json"))
+            continue
+        stats["compared"] += 1
+        findings.extend(_compare(entry.name, want, budget, tol))
+
+    if update_golden:
+        # Prune renamed/removed entries: the golden mirrors MATRIX
+        # exactly (must-raise and ctor rows never compile).
+        live = {e.name for e in entries
+                if e.expect_error is None and e.builder != "ctor-bn-axis"}
+        golden["entries"] = {k: v for k, v in golden["entries"].items()
+                             if k in live}
+        golden["format"] = GOLDEN_FORMAT
+        golden["tolerance"] = tol
+        golden["jax"] = jax.__version__
+        save_golden(golden, golden_path)
+    return findings, stats

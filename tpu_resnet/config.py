@@ -307,6 +307,16 @@ class TrainConfig:
     # Purely host-side: does not change the compiled program (no new
     # config-matrix rows needed).
     mfu_accounting: bool = True
+    # Memory ledger (tpu_resnet/obs/memory.py): extract the compiled
+    # train step's HBM budget (argument/output/temp/alias bytes —
+    # donation-credited) into <train_dir>/memory.json once at first
+    # dispatch, and sample live hbm_* gauges from device.memory_stats()
+    # at log boundaries. Unlike mfu accounting the budget needs a
+    # COMPILED program, so this pays ONE extra XLA compile at startup
+    # (charged to the compile window, excluded from throughput);
+    # failures degrade to absent, never kill training. Host-side only:
+    # no compiled-program change, no new config-matrix rows.
+    memory_ledger: bool = True
 
 
 @dataclasses.dataclass
@@ -341,12 +351,17 @@ class ResilienceConfig:
     eval_restore_backoff_sec: float = 0.5
     # ---- fault injection (resilience/faultinject.py; drills only) ----
     # All off by default; TPU_RESNET_FAULT_{NAN_STEP,STALL_STEP,STALL_SEC,
-    # SIGTERM_STEP,CORRUPT_CKPT} env vars override these fields.
+    # SIGTERM_STEP,CORRUPT_CKPT,OOM_STEP} env vars override these fields.
     inject_nan_at_step: int = -1
     inject_stall_at_step: int = -1
     inject_stall_seconds: float = 0.0
     inject_sigterm_at_step: int = -1
     inject_corrupt_ckpt: bool = False
+    # Raise a synthetic RESOURCE_EXHAUSTED (the XLA OOM status) at this
+    # chunk boundary — the drill for the OOM-forensics path: the loop
+    # must write <train_dir>/oom_report.json (ledger, gauge history,
+    # live-array census) before re-raising (doctor --mem-probe).
+    inject_oom_at_step: int = -1
 
 
 @dataclasses.dataclass

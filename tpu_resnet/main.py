@@ -144,6 +144,13 @@ def main(argv=None):
                                 "— child deadlines honored, complete "
                                 "RESULT_JSON trajectory, perfwatch "
                                 "ingestion")
+            p.add_argument("--mem-probe", action="store_true",
+                           help="memory-observability drill (~60s tiny "
+                                "CPU runs): live hbm gauge scrape + "
+                                "memory.json ledger matching flops.json "
+                                "keys, then a fault-injected "
+                                "RESOURCE_EXHAUSTED that must leave a "
+                                "schema-valid oom_report.json")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -165,7 +172,8 @@ def main(argv=None):
                              serve_probe=args.serve_probe,
                              trace_probe=args.trace_probe,
                              perfwatch=args.perfwatch,
-                             sweep_probe=args.sweep_probe)
+                             sweep_probe=args.sweep_probe,
+                             mem_probe=args.mem_probe)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

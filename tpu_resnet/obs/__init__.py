@@ -28,6 +28,11 @@ package provides them as first-class artifacts of every run:
                 table, per-compiled-program FLOPs registry (keyed like
                 the golden-jaxpr entries), live ``model_flops_per_sec``
                 / ``mfu`` gauges.
+``memory``      the space twin of ``mfu``: compiled-program HBM ledger
+                (``memory.json``, keyed like the FLOPs registry), live
+                ``hbm_bytes_*`` gauges from ``device.memory_stats()``,
+                OOM forensics (``oom_report.json`` with a live-array
+                census) and the per-chip HBM capacity table.
 ``trace``       ``tpu_resnet trace-export`` — merge spans, breakdown
                 samples, data-engine counters, eval and serve events
                 into one Chrome-trace/Perfetto JSON correlated by the
@@ -39,7 +44,7 @@ the doctor's telemetry check — can use the scrape/parse helpers without
 pulling in a backend.
 """
 
-from tpu_resnet.obs import mfu
+from tpu_resnet.obs import memory, mfu
 from tpu_resnet.obs.breakdown import StepBreakdown
 from tpu_resnet.obs.manifest import (
     build_manifest,
@@ -68,6 +73,7 @@ __all__ = [
     "build_manifest",
     "ensure_run_id",
     "histogram_quantile",
+    "memory",
     "mfu",
     "parse_histograms",
     "parse_prometheus",

@@ -77,6 +77,17 @@ CORE_GAUGES = (
     ("model_flops_per_sec", "Achieved model FLOP/s over the last "
                             "interval (global, all chips)"),
     ("mfu", "Model FLOPs utilization vs aggregate peak (0..1)"),
+    # Live device memory (tpu_resnet/obs/memory.py): device.memory_stats()
+    # sampled at log boundaries — zero device syncs. On backends without
+    # stats (CPU) the series stay at these explicit zeros
+    # (degrade-to-absent for the values, never for the series).
+    ("hbm_bytes_in_use", "Device memory in use, max across this host's "
+                         "devices (0 where memory_stats is unsupported)"),
+    ("hbm_bytes_peak", "Peak device memory since process start, max "
+                       "across this host's devices"),
+    ("hbm_bytes_limit", "Per-device memory capacity (backend-reported, "
+                        "else the obs/memory HBM table)"),
+    ("hbm_utilization", "hbm_bytes_in_use / hbm_bytes_limit (0..1)"),
     # Fault counters (tpu_resnet/resilience) — pre-declared so a scrape on
     # a healthy run reports explicit zeros, not absent series.
     ("fault_nan_rollbacks", "NaN/divergence rollbacks performed"),

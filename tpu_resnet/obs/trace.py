@@ -25,6 +25,22 @@ Lanes (Chrome trace "processes"/"threads"):
   in-process sidecar (train_and_eval) shares the trainer's pid and shows
   up as another thread of the same process — which is the truth.
 - **serve** (``serve_events.jsonl``): warmup, hot-reload, drain spans.
+- **device-memory** (counter thread on the trainer lane): the live
+  ``hbm_bytes_in_use``/``hbm_bytes_peak``/``hbm_utilization`` gauges the
+  loop samples from ``device.memory_stats()`` at log boundaries
+  (obs/memory.py) — HBM pressure rendered against the same timeline as
+  the compile/checkpoint/step spans that move it.
+- **device trace** (``--device-trace``): the ``jax.profiler`` capture of
+  a step window (tools/profiling.py StepTracer,
+  ``train.profile_steps``) merged in as per-device lanes. The profiler's
+  own Chrome-trace export (``profile/plugins/profile/<ts>/*.trace.json
+  [.gz]``) uses a timebase relative to its session start; the exporter
+  re-anchors it on the wall clock of the trainer's ``profiler_trace``
+  span — the host span that wrapped the capture — so XLA device/compile
+  activity lands in true host time next to the dispatch spans that
+  caused it, closing the host↔device attribution gap. Python-tracer
+  events (``$``-prefixed) are dropped: the host-side story already lives
+  on the trainer lane as spans.
 
 Correlation key: the ``run_id`` every writer stamps (obs/manifest.py).
 The exporter records it in trace metadata and appends it to each lane's
@@ -60,6 +76,16 @@ _TID_ENGINE = 3
 # is visible at a glance in Perfetto.
 _TID_H2D = 4
 _H2D_SPAN = "h2d_transfer"
+# Device-memory counter thread: the hbm_* gauges obs/memory.py samples
+# at log boundaries, rendered as their own lane so HBM pressure lines up
+# against the spans (compile, checkpoint, eval) that move it.
+_TID_MEMORY = 5
+# Merged jax.profiler lanes keep their own pid space well away from the
+# host lanes (real host pids are ~1e3-1e6; profiler pids are small ints
+# that would collide with the synthetic fallbacks).
+_DEVICE_TRACE_PID_BASE = 9000000
+_DEVICE_TRACE_EVENT_CAP = 200000
+_PROFILER_SPAN = "profiler_trace"
 
 # Counter series lifted from metrics.jsonl records onto counter threads:
 # (record key, counter thread, counter name).
@@ -73,6 +99,9 @@ _COUNTER_KEYS = (
      "data_decode_images_per_sec"),
     ("h2d_bytes_per_sec", _TID_H2D, "h2d_bytes_per_sec"),
     ("h2d_overlap_frac", _TID_H2D, "h2d_overlap_frac"),
+    ("hbm_bytes_in_use", _TID_MEMORY, "hbm_bytes_in_use"),
+    ("hbm_bytes_peak", _TID_MEMORY, "hbm_bytes_peak"),
+    ("hbm_utilization", _TID_MEMORY, "hbm_utilization"),
 )
 
 _INTERVAL_ARG_KEYS = (
@@ -82,7 +111,7 @@ _INTERVAL_ARG_KEYS = (
     "model_flops_per_sec", "mfu", "train_step_ms_p50", "train_step_ms_p95",
     "train_step_ms_p99", "data_ring_occupancy",
     "data_decode_images_per_sec", "h2d_bytes_per_sec",
-    "h2d_overlap_frac",
+    "h2d_overlap_frac", "hbm_bytes_in_use", "hbm_utilization",
 )
 
 
@@ -168,7 +197,150 @@ def _run_ids(spans: List[dict]) -> List[str]:
     return sorted({str(s["run_id"]) for s in spans if s.get("run_id")})
 
 
-def build_trace(train_dir: str) -> dict:
+def find_device_trace_files(train_dir: str) -> List[str]:
+    """Chrome-trace exports of the NEWEST ``jax.profiler`` capture under
+    ``<train_dir>/profile`` (tools/profiling.py StepTracer layout:
+    ``profile/plugins/profile/<timestamp>/<host>.trace.json[.gz]``).
+    Capture dirs are named by timestamp, so lexical order is capture
+    order; files within a capture sort by name (one per host)."""
+    root = os.path.join(train_dir, "profile", "plugins", "profile")
+    try:
+        captures = sorted(d for d in os.listdir(root)
+                          if os.path.isdir(os.path.join(root, d)))
+    except OSError:
+        return []
+    for cap in reversed(captures):
+        files = sorted(
+            os.path.join(root, cap, f)
+            for f in os.listdir(os.path.join(root, cap))
+            if f.endswith(".trace.json") or f.endswith(".trace.json.gz"))
+        if files:
+            return files
+    return []
+
+
+def _load_profiler_json(path: str) -> dict:
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def _device_trace_events(train_dir: str, train_spans: List[dict],
+                         base: float) -> Tuple[List[dict], dict]:
+    """Merge the newest profiler capture as per-device lanes. Returns
+    ``(events, info)`` where ``info`` lands in trace metadata.
+
+    Timebase: profiler ``ts`` is microseconds since its session start.
+    The trainer's ``profiler_trace`` span wraps exactly that session
+    (StepTracer records it start_trace→stop_trace), so its wall-clock
+    ``start`` re-anchors the capture; without the span (a capture taken
+    out-of-band) the file's mtime end-anchors it — stable for fixed
+    inputs, so exports stay deterministic either way."""
+    files = find_device_trace_files(train_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"--device-trace: no profiler capture under "
+            f"{os.path.join(train_dir, 'profile')} — capture one with "
+            f"train.profile_steps='A:B' (tools/profiling.py)")
+    anchor = None
+    for s in train_spans:  # newest capture ↔ newest profiler span
+        if s.get("span") == _PROFILER_SPAN and s.get("start") is not None:
+            anchor = float(s["start"])
+    events: List[dict] = []
+    pid_map: Dict[int, int] = {}
+    dropped = python_tracer = 0
+    max_ts = 0.0
+    for path in files:
+        try:
+            payload = _load_profiler_json(path)
+        except (OSError, ValueError) as e:
+            raise ValueError(f"--device-trace: unreadable profiler "
+                             f"export {path}: {e}")
+        for ev in payload.get("traceEvents", []):
+            if not isinstance(ev, dict):
+                continue
+            ph = ev.get("ph")
+            name = str(ev.get("name", ""))
+            if ph == "M":
+                if ev.get("name") not in ("process_name", "thread_name",
+                                          "process_sort_index",
+                                          "thread_sort_index"):
+                    dropped += 1
+                    continue
+            elif ph in ("X", "C", "i"):
+                if name.startswith("$"):
+                    # Python-tracer events: the host-side story is
+                    # already on the trainer lane as spans.
+                    python_tracer += 1
+                    continue
+                ts = ev.get("ts")
+                if not isinstance(ts, (int, float)):
+                    dropped += 1
+                    continue
+                max_ts = max(max_ts, float(ts))
+            else:
+                dropped += 1
+                continue
+            events.append(ev)
+    if anchor is None:
+        # End-anchor on the newest file's mtime: mtime is stop_trace's
+        # write, so capture start ≈ mtime - duration.
+        anchor = max(os.path.getmtime(p) for p in files) - max_ts / 1e6
+    offset = _us(anchor, base)
+    out: List[dict] = []
+    for ev in events:
+        pid = ev.get("pid")
+        pid = pid if isinstance(pid, int) else -1
+        if pid not in pid_map:
+            pid_map[pid] = _DEVICE_TRACE_PID_BASE + len(pid_map)
+        ph = ev.get("ph")
+        mapped = {"name": str(ev.get("name", "")), "ph": ph,
+                  "pid": pid_map[pid]}
+        if "tid" in ev:
+            mapped["tid"] = ev["tid"]
+        if ph == "M":
+            mapped["ts"] = 0.0
+            mapped["args"] = dict(ev.get("args") or {})
+            if ev.get("name") == "process_name":
+                label = str((ev.get("args") or {}).get("name", "?"))
+                mapped["args"]["name"] = f"device-trace: {label}"
+        else:
+            mapped["ts"] = max(0.0, round(offset + float(ev["ts"]), 1))
+            mapped["cat"] = "device"
+            if ph == "X":
+                try:
+                    dur = max(0.0, float(ev.get("dur", 0.0)))
+                except (TypeError, ValueError):
+                    dur = 0.0
+                mapped["dur"] = round(dur, 1)
+            if ph == "i":
+                mapped["s"] = "t"
+            if ev.get("args"):
+                mapped["args"] = ev["args"]
+        out.append(mapped)
+    slices = [e for e in out if e["ph"] != "M"]
+    if len(slices) > _DEVICE_TRACE_EVENT_CAP:
+        # Never a silent cap: keep the earliest slices (the window start
+        # is where dispatch↔device attribution is read) and report the
+        # drop in metadata.
+        slices.sort(key=lambda e: e["ts"])
+        dropped += len(slices) - _DEVICE_TRACE_EVENT_CAP
+        keep = set(map(id, slices[:_DEVICE_TRACE_EVENT_CAP]))
+        out = [e for e in out if e["ph"] == "M" or id(e) in keep]
+    info = {"files": [os.path.relpath(p, train_dir) for p in files],
+            "anchor_unix": round(anchor, 6),
+            "anchored_by": ("profiler_trace_span" if any(
+                s.get("span") == _PROFILER_SPAN for s in train_spans)
+                else "file_mtime"),
+            "events": sum(1 for e in out if e["ph"] != "M"),
+            "python_tracer_events_dropped": python_tracer,
+            "events_dropped": dropped}
+    return out, info
+
+
+def build_trace(train_dir: str, device_trace: bool = False) -> dict:
     """Assemble the merged Chrome-trace dict (pure read; no writes)."""
     sources: Dict[str, List[dict]] = {
         "train": load_spans(os.path.join(train_dir, "events.jsonl")),
@@ -236,7 +408,16 @@ def build_trace(train_dir: str) -> dict:
         if any("data_ring_occupancy" in r for r in metrics):
             events.append(_meta("thread_name", pid, _TID_ENGINE,
                                 "data-engine"))
+        if any("hbm_bytes_in_use" in r for r in metrics):
+            events.append(_meta("thread_name", pid, _TID_MEMORY,
+                                "device-memory"))
         events.extend(_metrics_events(metrics, base, pid))
+
+    device_trace_info = None
+    if device_trace:
+        dev_events, device_trace_info = _device_trace_events(
+            train_dir, sources["train"], base)
+        events.extend(dev_events)
 
     events.sort(key=lambda e: (e["ts"], e["pid"], e.get("tid", 0),
                                e["ph"], e["name"]))
@@ -249,6 +430,8 @@ def build_trace(train_dir: str) -> dict:
             "run_id": run_id,
             "source_run_ids": source_run_ids,
             "base_time_unix": base,
+            **({"device_trace": device_trace_info}
+               if device_trace_info else {}),
         },
     }
 
@@ -299,12 +482,12 @@ def validate_trace(trace: dict) -> List[str]:
     return problems
 
 
-def export_trace(train_dir: str, out: Optional[str] = None
-                 ) -> Tuple[str, dict]:
+def export_trace(train_dir: str, out: Optional[str] = None,
+                 device_trace: bool = False) -> Tuple[str, dict]:
     """Build + write the merged trace. Deterministic output (atomic
     tmp+rename, sorted keys) so a re-export over unchanged inputs is
     byte-identical. Returns ``(path, trace)``."""
-    trace = build_trace(train_dir)
+    trace = build_trace(train_dir, device_trace=device_trace)
     problems = validate_trace(trace)
     if problems:  # exporting an invalid trace would hide the bug
         raise ValueError("trace-export produced an invalid trace: "
@@ -331,12 +514,24 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", required=True, help="train dir of the run")
     ap.add_argument("--out", default="",
                     help="output path (default <dir>/trace.json)")
+    ap.add_argument("--device-trace", action="store_true",
+                    help="also merge the newest jax.profiler capture "
+                         "(<dir>/profile, train.profile_steps) as "
+                         "per-device lanes re-anchored on the trainer's "
+                         "profiler_trace span")
     args = ap.parse_args(argv)
     try:
-        path, trace = export_trace(args.dir, out=args.out or None)
+        path, trace = export_trace(args.dir, out=args.out or None,
+                                   device_trace=args.device_trace)
     except (OSError, ValueError) as e:
         print(f"trace-export failed: {e}")
         return 1
     n = len(trace["traceEvents"])
-    print(f"wrote {path} ({n} events, run_id={trace['metadata']['run_id']})")
+    meta = trace["metadata"]
+    print(f"wrote {path} ({n} events, run_id={meta['run_id']})")
+    if meta.get("device_trace"):
+        dt = meta["device_trace"]
+        print(f"device-trace: {dt['events']} events from "
+              f"{len(dt['files'])} file(s), anchored by "
+              f"{dt['anchored_by']}")
     return 0

@@ -41,17 +41,19 @@ class FaultPlan:
     stall_seconds: float = 0.0
     sigterm_at_step: int = -1    # SIGTERM to self at this chunk boundary
     corrupt_ckpt_at_start: bool = False  # corrupt newest ckpt before restore
+    oom_at_step: int = -1        # synthetic RESOURCE_EXHAUSTED at boundary
 
     @property
     def active(self) -> bool:
         return (self.nan_at_step >= 0 or self.sigterm_at_step >= 0
                 or (self.stall_at_step >= 0 and self.stall_seconds > 0)
-                or self.corrupt_ckpt_at_start)
+                or self.corrupt_ckpt_at_start or self.oom_at_step >= 0)
 
     @classmethod
     def from_config(cls, resilience_cfg, env=None) -> "FaultPlan":
         """Config fields overridden by ``TPU_RESNET_FAULT_*`` env vars:
-        NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT."""
+        NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT,
+        OOM_STEP."""
         env = os.environ if env is None else env
         r = resilience_cfg
 
@@ -68,6 +70,7 @@ class FaultPlan:
             corrupt_ckpt_at_start=pick(
                 "CORRUPT_CKPT", r.inject_corrupt_ckpt,
                 lambda v: v.lower() in ("1", "true", "yes")),
+            oom_at_step=pick("OOM_STEP", r.inject_oom_at_step, int),
         )
 
 
@@ -80,6 +83,7 @@ class FaultInjector:
         self._stall_fired = False
         self._sigterm_fired = False
         self._corrupt_fired = False
+        self._oom_fired = False
         if plan.active:
             log.warning("FAULT INJECTION ACTIVE: %s", plan)
 
@@ -125,6 +129,30 @@ class FaultInjector:
 
             log.warning("injecting SIGTERM at step %d", step)
             os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_oom(self, step: int) -> None:
+        """Raise a synthetic RESOURCE_EXHAUSTED at the first chunk
+        boundary >= the planned step — the exception class and status
+        string a real XLA device OOM raises, so the loop's forensics
+        path (``obs.memory.is_oom_error`` → oom_report.json) is drilled
+        end-to-end. The real ``XlaRuntimeError`` is used when
+        constructible; a RuntimeError carrying the same status is the
+        fallback (both satisfy ``is_oom_error``)."""
+        if (self.plan.oom_at_step < 0 or self._oom_fired
+                or step < self.plan.oom_at_step):
+            return
+        self._oom_fired = True
+        log.warning("injecting RESOURCE_EXHAUSTED at step %d", step)
+        msg = (f"RESOURCE_EXHAUSTED: injected OOM drill at step {step} "
+               f"(resilience.inject_oom_at_step) — out of memory while "
+               f"trying to allocate 18446744073709551615 bytes")
+        try:
+            from jax._src.lib import xla_client
+
+            err = xla_client.XlaRuntimeError(msg)
+        except Exception:  # noqa: BLE001 - private-API drift
+            err = RuntimeError(msg)
+        raise err
 
     def maybe_corrupt_checkpoint(self, train_dir: str) -> None:
         """Corrupt the newest checkpoint before the startup restore (the

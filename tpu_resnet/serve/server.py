@@ -45,6 +45,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from tpu_resnet.config import RunConfig
+from tpu_resnet.obs import memory as memory_obs
 from tpu_resnet.obs.manifest import read_run_id
 from tpu_resnet.obs.server import (SERVE_GAUGES, SERVE_HISTOGRAMS,
                                    TelemetryRegistry)
@@ -154,6 +155,23 @@ class PredictServer:
             target=self._httpd.serve_forever, name="tpu-resnet-serve-http",
             daemon=True)
         self._closed = False
+        self._oom_reported = False
+
+    def note_oom(self, error, phase: str = "infer") -> None:
+        """OOM forensics for the serving process (obs/memory.py): the
+        first RESOURCE_EXHAUSTED — a bucket warmup that overflows HBM,
+        or an inference batch on a memory-starved colocated chip —
+        writes <train_dir>/oom_report.json with the live-array census,
+        once. Guarded: forensics never takes the server down."""
+        if self._oom_reported or not memory_obs.is_oom_error(error):
+            return
+        self._oom_reported = True
+        memory_obs.write_oom_report(
+            self.cfg.train.train_dir, error, context=f"serve-{phase}",
+            program_key=f"serve|buckets{list(map(int, self.buckets))}"
+                        f"|step{int(self.backend.model_step)}",
+            run_id=self.run_id)
+        self.spans.event("oom", phase=phase)
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> "PredictServer":
@@ -277,6 +295,7 @@ class PredictServer:
         except ValueError as e:
             return 400, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 - backend failure
+            self.note_oom(e)  # RESOURCE_EXHAUSTED gets its forensics
             return 500, {"error": f"{type(e).__name__}: {e}"}
         out = {"predictions": np.argmax(logits, axis=-1).tolist(),
                "model_step": int(self.backend.model_step),
@@ -395,7 +414,16 @@ def serve(cfg: RunConfig) -> int:
     server = PredictServer(cfg, spans=spans)
     clean = True
     with coordinator:
-        server.start()
+        try:
+            server.start()
+        except Exception as e:
+            # Warmup compiles every bucket shape — the most likely spot
+            # for a serving OOM. Write the forensics artifact before the
+            # crash surfaces (the loop's closer-chain contract).
+            server.note_oom(e, phase="warmup")
+            server.close()
+            spans.close()
+            raise
         write_discovery(cfg.train.train_dir, server.port,
                         run_id=server.run_id)
         log.info("serve: ready on :%d — backend=%s model_step=%d "

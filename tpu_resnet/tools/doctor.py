@@ -33,8 +33,9 @@ Checks:
   check      optional (--check): the static-analysis suite (tpu_resnet/
              analysis): AST lints for the repo's JAX/TPU contracts plus
              the config-matrix abstract verifier with golden jaxpr
-             hashes — `python -m tpu_resnet check` for operators who
-             want one doctor line instead of the full report
+             hashes and the golden memory-budget engine — `python -m
+             tpu_resnet check` for operators who want one doctor line
+             instead of the full report
   serve_probe  optional (--serve-probe): a live predict-server smoke —
              train a tiny MLP, start ``tpu_resnet serve`` on an
              ephemeral port in a scrubbed CPU subprocess, wait for
@@ -57,6 +58,13 @@ Checks:
              RESULT_JSON trajectory complete and parseable, and
              perfwatch able to cohort it — so the MFU-campaign rig
              can't silently rot between chip windows
+  mem_probe  optional (--mem-probe): memory-observability drill
+             (tpu_resnet/obs/memory.py) — a tiny train must publish the
+             hbm_* gauge series live and write a memory.json ledger
+             certifying the same program keys as flops.json; a second
+             run with an injected RESOURCE_EXHAUSTED must die loudly
+             AND leave a schema-valid oom_report.json with a live-array
+             census (docs/OBSERVABILITY.md)
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -615,6 +623,163 @@ def _check_sweep_probe(timeout: int = 300) -> dict:
         return out
 
 
+def _check_mem_probe(timeout: int = 300) -> dict:
+    """Memory-observability drill (tpu_resnet/obs/memory.py), two
+    scrubbed-CPU children:
+
+    1. a tiny train with telemetry up — the ``hbm_*`` gauge series must
+       be present in a LIVE /metrics scrape (explicit zeros on CPU,
+       where memory_stats is unsupported — presence, never absence, is
+       the contract), and after a graceful SIGTERM the ledger
+       ``memory.json`` must hold the step's budget with nonzero
+       argument/temp bytes, a donation credit, and EXACTLY the program
+       keys ``flops.json`` certified (one registry spelling for space
+       and time);
+    2. a train with a fault-injected RESOURCE_EXHAUSTED
+       (resilience.inject_oom_at_step) — the crash must leave a
+       schema-valid ``oom_report.json`` carrying a live-array census,
+       and the child must still die loudly (forensics never swallow the
+       OOM)."""
+    import signal
+    import tempfile
+    import time
+    import urllib.request
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
+    from tpu_resnet.obs.memory import validate_oom_report
+    from tpu_resnet.obs.server import parse_prometheus, read_telemetry_port
+    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
+
+    gauge_series = ("tpu_resnet_hbm_bytes_in_use",
+                    "tpu_resnet_hbm_bytes_peak")
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_mem_") as d:
+        base = [sys.executable, "-m", "tpu_resnet", "train",
+                "--preset", "smoke", f"train.train_dir={d}",
+                "train.train_steps=2000", "train.log_every=2",
+                "train.summary_every=2", "train.checkpoint_every=50",
+                "train.image_summary_every=0", "train.steps_per_call=2",
+                "train.telemetry_port=0", "model.name=mlp",
+                "data.device_resident=off", "data.transfer_stage=1"]
+        log_path = os.path.join(d, "mem_probe_child.log")
+        log_fh = open(log_path, "w")
+
+        def _tail():
+            log_fh.flush()
+            try:
+                with open(log_path) as f:
+                    return f.read().strip().splitlines()[-5:]
+            except OSError:
+                return []
+
+        proc = subprocess.Popen(base, env=scrubbed_cpu_env(1),
+                                stdout=log_fh, stderr=subprocess.STDOUT,
+                                text=True)
+        try:
+            live = {}
+            deadline = time.time() + timeout
+            while time.time() < deadline and proc.poll() is None:
+                port = read_telemetry_port(d)
+                if port is not None:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{port}/metrics",
+                                timeout=2) as r:
+                            metrics = parse_prometheus(r.read().decode())
+                        if (all(s in metrics for s in gauge_series)
+                                and os.path.exists(
+                                    os.path.join(d, "memory.json"))):
+                            live = {s: metrics[s] for s in gauge_series}
+                            break
+                    except (OSError, ValueError):
+                        pass  # not listening yet / mid-write
+                time.sleep(0.3)
+            if not live:
+                proc.kill()
+                proc.wait(timeout=10)
+                return {"ok": False, "phase": "live_scrape",
+                        "error": "hbm gauge series / memory.json never "
+                                 "went live", "tail": _tail()}
+            proc.send_signal(signal.SIGTERM)
+            try:
+                rc = proc.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                return {"ok": False, "phase": "preempt",
+                        "error": "trainer did not exit within 120s of "
+                                 "SIGTERM", "tail": _tail()}
+            if rc not in (0, PREEMPT_EXIT_CODE):
+                return {"ok": False, "phase": "preempt", "rc": rc,
+                        "tail": _tail()}
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            log_fh.close()
+
+        try:
+            with open(os.path.join(d, "memory.json")) as f:
+                ledger = json.load(f).get("entries", {})
+        except (OSError, ValueError) as e:
+            return {"ok": False, "phase": "ledger",
+                    "error": f"memory.json unreadable: {e}"}
+        bad = [k for k, e in ledger.items()
+               if not (e.get("argument_bytes", 0) > 0
+                       and e.get("temp_bytes", 0) > 0
+                       and e.get("alias_bytes", 0) > 0)]
+        if not ledger or bad:
+            return {"ok": False, "phase": "ledger", "entries": list(ledger),
+                    "missing_bytes": bad,
+                    "error": "ledger empty or missing nonzero argument/"
+                             "temp/alias (donation) bytes"}
+        try:
+            with open(os.path.join(d, "flops.json")) as f:
+                flops_keys = sorted(json.load(f).get("entries", {}))
+        except (OSError, ValueError) as e:
+            return {"ok": False, "phase": "ledger",
+                    "error": f"flops.json unreadable: {e}"}
+        if sorted(ledger) != flops_keys:
+            return {"ok": False, "phase": "ledger",
+                    "error": "memory.json and flops.json certify "
+                             "different program keys",
+                    "memory_keys": sorted(ledger),
+                    "flops_keys": flops_keys}
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_oom_") as d:
+        rc_oom, out = run_scrubbed_subprocess(
+            [sys.executable, "-m", "tpu_resnet", "train",
+             "--preset", "smoke", f"train.train_dir={d}",
+             "train.train_steps=40", "train.log_every=5",
+             "train.summary_every=20", "train.checkpoint_every=50",
+             "train.image_summary_every=0", "train.steps_per_call=5",
+             "train.telemetry_port=-1", "model.name=mlp",
+             "data.device_resident=off", "data.transfer_stage=1",
+             "resilience.inject_oom_at_step=10"],
+            n_devices=1, timeout=timeout)
+        if rc_oom == 0:
+            return {"ok": False, "phase": "oom",
+                    "error": "injected RESOURCE_EXHAUSTED did not fail "
+                             "the run (forensics must re-raise)",
+                    "tail": out.strip().splitlines()[-5:]}
+        report_path = os.path.join(d, "oom_report.json")
+        try:
+            with open(report_path) as f:
+                report = json.load(f)
+        except (OSError, ValueError) as e:
+            return {"ok": False, "phase": "oom",
+                    "error": f"oom_report.json unreadable: {e}",
+                    "tail": out.strip().splitlines()[-5:]}
+        problems = validate_oom_report(report)
+        census = (report.get("live_arrays") or {})
+        if not census.get("total_arrays"):
+            problems.append("live-array census is empty")
+        if problems:
+            return {"ok": False, "phase": "oom", "problems": problems}
+        return {"ok": True, **live,
+                "ledger_keys": flops_keys,
+                "oom_rc": rc_oom,
+                "oom_census_buckets": len(census.get("buckets", [])),
+                "oom_census_bytes": census.get("total_bytes")}
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -660,7 +825,8 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
-               sweep_probe: bool = False, stream=None) -> dict:
+               sweep_probe: bool = False, mem_probe: bool = False,
+               stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -705,6 +871,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if sweep_probe:
         summary["sweep_probe"] = _check_sweep_probe()
         emit("sweep_probe", summary["sweep_probe"])
+    if mem_probe:
+        summary["mem_probe"] = _check_mem_probe()
+        emit("mem_probe", summary["mem_probe"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

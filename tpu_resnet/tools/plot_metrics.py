@@ -13,8 +13,10 @@ step-time breakdown (data-wait fraction + sampled device step time from
 tpu_resnet/obs/breakdown.py — the "are we input-bound" panel), and the
 MFU / step-time-percentile panel (the live mfu gauge + train_step_ms
 histogram percentiles from tpu_resnet/obs/mfu.py and obs/server.py — the
-"is the chip utilized" panel). Also exports the merged series as CSV with
-``--csv`` (the ps1workers1.csv role).
+"is the chip utilized" panel, now also carrying the hbm_utilization
+series from tpu_resnet/obs/memory.py where the backend reports device
+memory). Also exports the merged series as CSV with ``--csv`` (the
+ps1workers1.csv role).
 """
 
 from __future__ import annotations
@@ -144,6 +146,13 @@ def plot(train_dir: str, out: Optional[str] = None,
         ax.plot(xs, [100 * y for y in ys], color="tab:green",
                 label="MFU %")
         ax.set_ylim(0, max(102, 110 * max(ys)))
+    # HBM utilization (obs/memory.py gauges) next to MFU: the two
+    # utilizations every memory/compute trade (batch, remat, donation)
+    # moves against each other. Absent on backends without memory_stats.
+    xs, ys = _column(train, "hbm_utilization")
+    if xs:
+        ax.plot(xs, [100 * y for y in ys], color="tab:blue",
+                linestyle="-.", label="HBM util %")
     ax.set_xlabel("step")
     ax3 = ax.twinx()
     for key, style in (("train_step_ms_p50", "-"),
@@ -160,6 +169,10 @@ def plot(train_dir: str, out: Optional[str] = None,
                   if "model_flops_per_sec" in r), None)
     if flops is not None:
         title += f" ({flops / 1e9:.1f} GFLOP/s)"
+    hbm_peak = next((r["hbm_bytes_peak"] for r in reversed(train)
+                     if "hbm_bytes_peak" in r), None)
+    if hbm_peak:
+        title += f" (HBM peak {hbm_peak / 2**30:.1f} GiB)"
     ax.set_title(title)
     h1, l1 = ax.get_legend_handles_labels()
     h3, l3 = ax3.get_legend_handles_labels()

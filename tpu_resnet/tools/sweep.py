@@ -316,6 +316,23 @@ def measure_point(point: Dict, args) -> Dict:
         result.update(bd.interval())
         if hasattr(it, "stats"):
             result.update(it.stats())
+        # Peak HBM of the point (obs/memory.py, device.memory_stats()):
+        # the measurement every knob verdict needs next to steps/sec — a
+        # knob that "wins" throughput by blowing the memory budget shows
+        # it here, and perfwatch --sweep gates on it. Absent on backends
+        # without stats (CPU), like mfu without a peak table.
+        from tpu_resnet.obs.memory import sample_device_memory
+
+        hbm = sample_device_memory()
+        if hbm:
+            result["hbm_bytes_peak"] = int(hbm["hbm_bytes_peak"])
+            # Utilization from PEAK, not the post-window in_use (temp/
+            # activation buffers are already freed by now) — same
+            # semantics as bench._hbm_snapshot, so a point's sweep
+            # record and its bench round agree on headroom.
+            if hbm.get("hbm_bytes_limit"):
+                result["hbm_utilization"] = round(
+                    hbm["hbm_bytes_peak"] / hbm["hbm_bytes_limit"], 4)
         if deadline is not None:
             result["deadline_margin_sec"] = round(deadline - time.time(), 1)
     finally:

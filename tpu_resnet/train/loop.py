@@ -202,6 +202,11 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     run_wall0 = None
     step = last_ckpt_step = 0
     total = None
+    # Memory-forensics state must exist before the try: the OOM closer
+    # reads it even when setup fails ahead of the first dispatch.
+    mem_ledger = obs.memory.MemoryLedger()
+    mem_key = None
+    mem_ring = obs.memory.MemorySampleRing()
     try:
         # Fault-tolerance layer (tpu_resnet/resilience): preemption-graceful
         # shutdown, NaN rollback, hang watchdog — and, drills only, the
@@ -335,6 +340,10 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         # log boundary (pure host arithmetic — no device syncs).
         step_flops = None
         device_kind = mesh.devices.flat[0].device_kind
+        # Memory ledger (obs/memory.py): the step's HBM budget measured
+        # once at first dispatch; live hbm_* gauges sampled at log
+        # boundaries; mem_ledger/mem_key/mem_ring (initialized above the
+        # try) feed the OOM report in the closer chain.
 
         meter.rate(step)
         last_summary = step
@@ -348,6 +357,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         last_inputs = images_np[:4] if resident else None
         while step < total:
             injector.maybe_sigterm(step)
+            injector.maybe_oom(step)  # OOM-forensics drill (doctor)
             if shutdown.requested:
                 break  # stop at the chunk boundary; final save below
             tracer.before(step)
@@ -430,6 +440,46 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                             "mfu accounting failed (%s: %s) — mfu gauges "
                             "stay 0", type(e).__name__, e)
                     breakdown.reset_interval()
+                if cfg.train.memory_ledger:
+                    # HBM budget of the compiled step (obs/memory.py).
+                    # memory_analysis needs a COMPILED program and the
+                    # AOT path shares no cache with the jit dispatch:
+                    # this is ONE extra XLA compile, charged to the
+                    # compile window (meter re-primed below, never a
+                    # throughput interval). Degrades to absent.
+                    t_mem = time.time()
+                    try:
+                        # Measure the program THIS run's input edge
+                        # dispatches: the fused staged-chunk jit on the
+                        # streaming stage>1 path, else the plain sharded
+                        # step (the resident path's epoch-buffer chunk
+                        # is approximated by its single-step twin —
+                        # labeled so on the entry).
+                        staged_run = not resident and stage > 1
+                        entry = obs.memory.account_train_step(
+                            cfg, mesh, state, base_step,
+                            per_replica_bn=per_replica_bn,
+                            stage_rows=stage if staged_run else 1,
+                            chunk_steps=(max(1, cfg.train.steps_per_call)
+                                         if staged_run else 1),
+                            variant=("single-step (resident epoch-buffer "
+                                     "program approximated)" if resident
+                                     else "single-step"),
+                            ledger=mem_ledger,
+                            train_dir=(cfg.train.train_dir
+                                       if parallel.is_primary() else None))
+                        mem_key = entry.get("program_key")
+                        spans.record(
+                            "memory_account", t_mem, time.time(),
+                            program_key=mem_key,
+                            temp_bytes=entry.get("temp_bytes"),
+                            alias_bytes=entry.get("alias_bytes"),
+                            peak_bytes=entry.get("peak_bytes"))
+                    except Exception as e:  # noqa: BLE001 - accounting
+                        log.warning(            # must never kill training
+                            "memory ledger failed (%s: %s) — memory.json "
+                            "absent for this run", type(e).__name__, e)
+                    breakdown.reset_interval()
                 meter.rate(step)
                 last_sync = step
                 last_log_step = step
@@ -497,6 +547,13 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                             m["mfu"] = round(u, 4)
                 last_log_step = step
                 m.update(breakdown.interval())
+                # Live device-memory gauges (obs/memory.py): pure host
+                # introspection at this already-synced boundary — zero
+                # extra device syncs; {} on backends without stats.
+                hbm = obs.memory.sample_device_memory()
+                if hbm:
+                    m.update(hbm)
+                    mem_ring.add(step, hbm)
                 if host_iter is not None and hasattr(host_iter, "stats"):
                     # Engine cause-signal for data_wait: ring occupancy
                     # (0 while the step waits = producer-bound) and the
@@ -580,7 +637,20 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 log.warning("shutdown closer %s failed: %s",
                             getattr(fn, "__name__", fn), e)
 
-        exc_type = sys.exc_info()[0]
+        exc_type, exc_val = sys.exc_info()[:2]
+        if exc_val is not None and obs.memory.is_oom_error(exc_val):
+            # OOM forensics FIRST (cheap, pure host writes): the ledger,
+            # the recent hbm samples, a live-array census and the
+            # offending program key land in <train_dir>/oom_report.json
+            # before anything else touches the dying process — a pod OOM
+            # becomes a diagnosable artifact, not a dead log line. The
+            # original exception still propagates.
+            _close(lambda: obs.memory.write_oom_report(
+                cfg.train.train_dir, exc_val, context="train", step=step,
+                program_key=mem_key, ledger=mem_ledger,
+                samples=mem_ring.snapshot(), run_id=run_id))
+            _close(lambda: spans.event("oom", step=step,
+                                       program_key=mem_key))
         if (rcfg.emergency_save and exc_type is not None
                 and ckpt is not None
                 and not issubclass(exc_type, (resilience.DivergenceError,
