@@ -100,6 +100,12 @@ class MatrixEntry:
     model_axis: int = 1
     engine: str = "thread"
     batch: int = 16
+    # mesh.partition: replicated | zero1 (parallel/partition.py). zero1
+    # rows pin the cross-replica weight-update structure — the sharding
+    # constraints the SPMD partitioner turns into reduce-scatter +
+    # all-gather are part of the traced program, so they golden-hash
+    # like any other op.
+    partition: str = "replicated"
     classes: int = 0               # synthetic only; 0 = dataset default
     # Must-raise entries: regex the ValueError message must match.
     expect_error: Optional[str] = None
@@ -139,6 +145,7 @@ class MatrixEntry:
         cfg.model.stem_space_to_depth = self.s2d
         cfg.mesh.data = self.data_axis
         cfg.mesh.model = self.model_axis
+        cfg.mesh.partition = self.partition
         cfg.train.global_batch_size = self.batch
         return cfg
 
@@ -208,6 +215,18 @@ MATRIX: Tuple[MatrixEntry, ...] = (
        dtype="bfloat16", epilogue="on"),
     _e("cifar10_rn8_f32_mesh8_perreplica_epilogue", data_axis=8,
        sync_bn=False, epilogue="on"),
+    # --- zero1 cross-replica optimizer sharding (parallel/partition.py,
+    # parallel/zero.py, arXiv:2004.13336): the sharded weight update's
+    # constraint structure is pinned per config, the mesh1 identity twin
+    # asserts zero1 on a 1-way data axis compiles the EXACT replicated
+    # program, and the lowering check proves donation survives the
+    # per-shard optimizer-slot arguments.
+    _e("cifar10_rn8_f32_mesh8_zero1", data_axis=8, partition="zero1",
+       check_lowering=True),
+    _e("imagenet_rn18_bf16_mesh8_zero1", dataset="imagenet", size=18,
+       dtype="bfloat16", data_axis=8, partition="zero1"),
+    _e("cifar10_rn8_f32_zero1_mesh1", partition="zero1",
+       same_program_as="cifar10_rn8_f32"),
     # --- staged/double-buffered chunk program (device_data.make_chunk_fn)
     # The fused multi-step dispatch both streaming input edges execute —
     # including the new DoubleBufferedH2D path, whose contract is that
@@ -226,6 +245,11 @@ MATRIX: Tuple[MatrixEntry, ...] = (
                     "requires.*sync_bn"),
     _e("raise_ctor_fused_bn_axis", builder="ctor-bn-axis",
        expect_error="does not implement sync-BN"),
+    _e("raise_zero1_perreplica_mesh8", data_axis=8, sync_bn=False,
+       partition="zero1",
+       expect_error="zero1 on a multi-chip data axis requires.*sync_bn"),
+    _e("raise_bad_partition_mode", partition="zero2",
+       expect_error="mesh.partition must be one of"),
 )
 
 
@@ -287,14 +311,31 @@ def _abstract_programs(entry: MatrixEntry):
 
     augment_fn, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
     per_replica = (not cfg.model.sync_bn) and entry.data_axis > 1
+    # The partitioner traces over an AbstractMesh — the sharding
+    # constraints it injects (the zero1 weight update) carry only axis
+    # names/sizes into the jaxpr text, so the golden hash stays
+    # machine-independent like every other entry. Replicated entries get
+    # a non-sharding partitioner: make_update_fn then returns the plain
+    # optax chain, byte-identical to the pre-partitioner trace.
+    from tpu_resnet.parallel.partition import StatePartitioner
+
+    partitioner = StatePartitioner(
+        _abstract_mesh(entry.data_axis, entry.model_axis), entry.partition)
     step = make_train_step(model, cfg.optim, schedule,
                            cfg.data.num_classes, augment_fn,
                            base_rng=jax.random.PRNGKey(0), mesh=None,
-                           grad_axis="data" if per_replica else None)
+                           grad_axis="data" if per_replica else None,
+                           partitioner=partitioner)
     if per_replica:
         step = per_replica_shard_map(
             step, _abstract_mesh(entry.data_axis, entry.model_axis),
             in_specs=(P(), P("data"), P("data")))
+
+    if partitioner.is_sharded:
+        # The loop's startup gate, applied to the abstract state tree:
+        # an unshardable (model × mesh × partition) combination must be
+        # a per-leaf ValueError here too, not a silently replicated slot.
+        partitioner.validate(state_sds)
 
     imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
     labels = jax.ShapeDtypeStruct((entry.batch,), jnp.int32)
@@ -390,11 +431,17 @@ def verify_lowering(entry: MatrixEntry) -> List[Finding]:
         entry.data_axis, entry.model_axis), ("data", "model"))
     per_replica = (not cfg.model.sync_bn) and entry.data_axis > 1
     augment_fn, _ = aug_lib.get_augment_fns(cfg.data.dataset)
+    from tpu_resnet.parallel.partition import StatePartitioner
+
+    partitioner = StatePartitioner(mesh, entry.partition)
     base = make_train_step(model, cfg.optim, schedule,
                            cfg.data.num_classes, augment_fn,
                            base_rng=jax.random.PRNGKey(0), mesh=mesh,
-                           grad_axis="data" if per_replica else None)
-    jitted = shard_step(base, mesh, per_replica_bn=per_replica)
+                           grad_axis="data" if per_replica else None,
+                           partitioner=partitioner)
+    jitted = shard_step(base, mesh, per_replica_bn=per_replica,
+                        state_sharding=(partitioner.state_shardings(state_sds)
+                                        if partitioner.is_sharded else None))
     imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
     labels = jax.ShapeDtypeStruct((entry.batch,), jnp.int32)
     lowered = jitted.lower(state_sds, imgs, labels)

@@ -66,7 +66,11 @@ EXCLUDE_DIRS = {"tests", "docs", "launch", "__pycache__", ".git",
 # (file-level pragma with justification: it IS the host-side prober).
 JIT_SCOPE_FILES = ("tpu_resnet/train/step.py",
                    "tpu_resnet/serve/infer.py",
-                   "tpu_resnet/tools/sweep_measure.py")
+                   "tpu_resnet/tools/sweep_measure.py",
+                   # the zero1 weight update and the constraint helpers
+                   # it calls trace INSIDE the step program
+                   "tpu_resnet/parallel/zero.py",
+                   "tpu_resnet/parallel/partition.py")
 JIT_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 
 # Module-scope import closure of the spawn'd decode worker

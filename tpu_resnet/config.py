@@ -251,6 +251,18 @@ class MeshConfig:
     data: int = -1   # -1 = all remaining devices
     model: int = 1
     axis_names: tuple = ("data", "model")
+    # State partitioning scheme (tpu_resnet/parallel/partition.py — the
+    # single owner of every TrainState sharding decision):
+    # "replicated" keeps a full parameter + optimizer copy per device
+    # (classic data parallelism); "zero1" shards the optimizer slots and
+    # the weight update over the data axis via sharding annotations
+    # (arXiv:2004.13336) — ~N× less optimizer HBM per device on an N-way
+    # data axis, at the cost of an all-gather of the updated parameters
+    # per step (docs/PARALLELISM.md has the tradeoff and the golden
+    # memory-budget proof). Validated against the mesh at startup;
+    # requires model.sync_bn=true on multi-chip meshes (the shard_map
+    # per-replica-BN path cannot carry sharding constraints).
+    partition: str = "replicated"  # replicated | zero1
 
 
 @dataclasses.dataclass

@@ -148,7 +148,8 @@ def make_chunk_fn(base_step: Callable, c: int):
 
 def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
                                 per_replica_bn: bool = False,
-                                donate_state: bool = True):
+                                donate_state: bool = True,
+                                state_sharding=None):
     """Fused multi-step dispatch for the *streaming* input path — the
     counterpart of ``compile_resident_steps`` for data that arrives as
     staged ``(stage, B, ...)`` superbatches
@@ -164,9 +165,16 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
     scalar (no recompile per position); distinct ``c`` values compile
     once each (the loop only uses the handful its log/checkpoint
     boundaries require). Metrics are the last step's, like the
-    reference's LoggingTensorHook (resnet_cifar_train.py:282-287)."""
+    reference's LoggingTensorHook (resnet_cifar_train.py:282-287).
+
+    ``state_sharding`` is the TrainState-shaped sharding tree from
+    ``parallel.StatePartitioner.state_shardings`` (None = fully
+    replicated, the historical layout) — the zero1 loop passes its
+    sharded tree so the chunk program's optimizer-slot arguments compile
+    to per-shard buffers."""
     repl = NamedSharding(mesh, P())
     staged = NamedSharding(mesh, P(None, "data"))
+    state_in = state_sharding if state_sharding is not None else repl
     cache = {}
 
     def compiled(c: int):
@@ -180,7 +188,7 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
                     in_specs=(P(), P(None, "data"), P(None, "data"), P()))
             cache[c] = jax.jit(
                 chunk,
-                in_shardings=(repl, staged, staged, None),
+                in_shardings=(state_in, staged, staged, None),
                 donate_argnums=(0,) if donate_state else (),
             )
         return cache[c]
@@ -193,7 +201,8 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
 
 def compile_resident_steps(base_step: Callable, ds: DeviceDataset,
                            mesh: Mesh, steps_per_call: int,
-                           per_replica_bn: bool = False):
+                           per_replica_bn: bool = False,
+                           state_sharding=None):
     """Returns ``run(state, step, k) -> (state, metrics)`` executing ``k``
     steps (k ≤ steps_per_call) in one dispatch against the resident
     dataset.
@@ -216,7 +225,8 @@ def compile_resident_steps(base_step: Callable, ds: DeviceDataset,
     the epoch buffer's batch axis is sharded over 'data', so each replica
     slices its own local rows."""
     run_staged = compile_staged_stream_steps(base_step, mesh,
-                                             per_replica_bn=per_replica_bn)
+                                             per_replica_bn=per_replica_bn,
+                                             state_sharding=state_sharding)
 
     def run(state, step: int, k: int):
         """``step`` is the host-tracked step counter (avoids a device sync);

@@ -31,6 +31,7 @@ from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
 from tpu_resnet.train.checkpoint import (CheckpointManager, latest_step_in,
+                                         partitioned_template,
                                          restore_with_retry)
 from tpu_resnet.train.metrics_io import MetricsWriter
 from tpu_resnet.train.state import init_state
@@ -102,16 +103,26 @@ def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn
     return correct / max(count, 1), loss_sum / max(count, 1), count
 
 
-def build_eval_step(cfg: RunConfig, mesh):
+def build_eval_step(cfg: RunConfig, mesh, state_sharding=None):
+    """``state_sharding`` (a TrainState-shaped sharding tree, e.g. from
+    the partitioned restore template) lets the eval step accept the
+    run's partition layout directly — a zero1 state's sharded optimizer
+    slots ride through untouched (eval reads only params/batch_stats,
+    which every partition mode keeps replicated). None = the historical
+    fully-replicated signature."""
     model = build_model(cfg)
     _, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
     step = make_eval_step(model, cfg.data.num_classes, eval_pre)
     return model, jax.jit(step, in_shardings=(
-        parallel.replicated(mesh), parallel.batch_sharding(mesh),
+        state_sharding if state_sharding is not None
+        else parallel.replicated(mesh), parallel.batch_sharding(mesh),
         parallel.batch_sharding(mesh)))
 
 
 def _template_state(cfg: RunConfig, model, mesh):
+    """CONCRETE replicated state (multihost smoke workers run an eval
+    pass on it directly); the evaluator's restore path uses the
+    allocation-free abstract ``checkpoint.partitioned_template``."""
     schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
     size = cfg.data.resolved_image_size
     state = init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
@@ -133,8 +144,15 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
     start-resnet-imagenet-main.sh tail, and kills it with stop.sh)."""
     if mesh is None:
         mesh = parallel.create_mesh(cfg.mesh)
-    model, eval_step_fn = build_eval_step(cfg, mesh)
-    template = _template_state(cfg, model, mesh)
+    # Abstract restore template in the run's partition layout
+    # (checkpoint.partitioned_template): no device allocation for the
+    # template, and a zero1 checkpoint restores straight into its
+    # optimizer-slot shards. The eval step accepts that same layout.
+    template = partitioned_template(cfg, mesh)
+    model, eval_step_fn = build_eval_step(
+        cfg, mesh,
+        state_sharding=jax.tree_util.tree_map(lambda s: s.sharding,
+                                              template))
 
     eval_dir = os.path.join(cfg.train.train_dir, "eval")
     metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())

@@ -119,16 +119,15 @@ def export_from_checkpoint(cfg: RunConfig, out_dir: str,
     """checkpoint dir (cfg.train.train_dir) → frozen artifact — the 4-step
     freeze recipe (resnet_cifar_frozen_model.py:2-23) as one call."""
     from tpu_resnet import parallel
-    from tpu_resnet.train import build_schedule, init_state
-    from tpu_resnet.train.checkpoint import CheckpointManager
+    from tpu_resnet.train.checkpoint import (CheckpointManager,
+                                             partitioned_template)
 
     mesh = parallel.create_mesh(cfg.mesh)
     model = build_model(cfg)
-    schedule = build_schedule(cfg.optim, cfg.train)
-    size = cfg.data.resolved_image_size
-    template = init_state(model, cfg.optim, schedule, jax.random.PRNGKey(0),
-                          jnp.zeros((1, size, size, 3)))
-    template = jax.device_put(template, parallel.replicated(mesh))
+    # Abstract template in the run's partition layout (no device
+    # allocation; a zero1 run's checkpoint restores into its shards and
+    # the replicated params/stats below are untouched by the mode).
+    template = partitioned_template(cfg, mesh, model=model)
     ckpt = CheckpointManager(cfg.train.train_dir)
     state = ckpt.restore(template, step=step)
     return save_inference(cfg, jax.device_get(state.params),

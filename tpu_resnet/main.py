@@ -151,6 +151,13 @@ def main(argv=None):
                                 "keys, then a fault-injected "
                                 "RESOURCE_EXHAUSTED that must leave a "
                                 "schema-valid oom_report.json")
+            p.add_argument("--partition-probe", action="store_true",
+                           help="ZeRO-1 partitioner drill (~90s tiny CPU "
+                                "runs on an 8-device fakepod): zero1 "
+                                "optimizer-slot ledger bytes < 0.3x the "
+                                "replicated twin's, SIGTERM + exact-step "
+                                "resume under zero1, perfwatch peak-HBM "
+                                "ingestion")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -173,7 +180,8 @@ def main(argv=None):
                              trace_probe=args.trace_probe,
                              perfwatch=args.perfwatch,
                              sweep_probe=args.sweep_probe,
-                             mem_probe=args.mem_probe)
+                             mem_probe=args.mem_probe,
+                             partition_probe=args.partition_probe)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

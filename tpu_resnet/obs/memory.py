@@ -199,6 +199,7 @@ def account_train_step(cfg, mesh, state, base_step,
                        per_replica_bn: bool = False,
                        stage_rows: int = 1, chunk_steps: int = 1,
                        variant: str = "single-step",
+                       partitioner=None,
                        ledger: Optional[MemoryLedger] = None,
                        train_dir: Optional[str] = None) -> dict:
     """Measure and register the train step's HBM budget for ``cfg`` on
@@ -216,7 +217,15 @@ def account_train_step(cfg, mesh, state, base_step,
     ``variant`` label is recorded on the entry so an OOM report says
     which program shape its budget describes (the resident path's
     epoch-buffer program is approximated by its single-step twin, and
-    says so)."""
+    says so).
+
+    ``partitioner`` (parallel.StatePartitioner) supplies the run's state
+    layout: the probe compiles with the same in_shardings the loop
+    dispatches (zero1 = per-shard optimizer-slot arguments) and the
+    entry carries the partitioner's analytic per-component breakdown
+    (``params_argument_bytes`` / ``opt_state_argument_bytes`` /
+    ``batch_stats_argument_bytes``), so the zero1 optimizer cut is a
+    named number next to XLA's aggregate ``argument_bytes``."""
     import jax
 
     from tpu_resnet import parallel
@@ -225,6 +234,9 @@ def account_train_step(cfg, mesh, state, base_step,
 
     ledger = ledger if ledger is not None else MemoryLedger()
     key = train_program_key(cfg, dict(mesh.shape))
+    state_sharding = (partitioner.state_shardings(state)
+                     if partitioner is not None and partitioner.is_sharded
+                     else None)
     size = cfg.data.resolved_image_size
     gb = cfg.train.global_batch_size
     img_dtype = "float32" if cfg.data.dataset == "imagenet" else "uint8"
@@ -245,7 +257,8 @@ def account_train_step(cfg, mesh, state, base_step,
                 in_specs=(P(), P(None, "data"), P(None, "data"), P()))
         jitted = jax.jit(
             chunk,
-            in_shardings=(NamedSharding(mesh, P()),
+            in_shardings=(state_sharding if state_sharding is not None
+                          else NamedSharding(mesh, P()),
                           NamedSharding(mesh, P(None, "data")),
                           NamedSharding(mesh, P(None, "data")), None),
             donate_argnums=(0,))
@@ -261,14 +274,22 @@ def account_train_step(cfg, mesh, state, base_step,
         images = jax.ShapeDtypeStruct((gb, size, size, 3), img_dtype,
                                       sharding=bs)
         labels = jax.ShapeDtypeStruct((gb,), "int32", sharding=bs)
-        probe = shard_step(base_step, mesh, per_replica_bn=per_replica_bn)
+        probe = shard_step(base_step, mesh, per_replica_bn=per_replica_bn,
+                           state_sharding=state_sharding)
         lowered = probe.lower(state, images, labels)
     budget = budget_from_compiled(lowered.compile())
     kind = mesh.devices.flat[0].device_kind
+    extra = {}
+    if partitioner is not None:
+        extra["partition"] = partitioner.describe()
+        try:
+            extra.update(partitioner.state_argument_bytes(state))
+        except Exception as e:  # noqa: BLE001 - accounting must not crash
+            log.debug("state argument breakdown unavailable: %s", e)
     entry = ledger.register(
         key, budget, program_key=key, program=variant, global_batch=gb,
         device_kind=kind, n_devices=int(mesh.size),
-        hbm_bytes_per_chip=hbm_bytes_per_chip(kind))
+        hbm_bytes_per_chip=hbm_bytes_per_chip(kind), **extra)
     if train_dir:
         ledger.save(train_dir)
     return entry

@@ -135,6 +135,10 @@ def train_program_key(cfg, mesh_shape: Dict[str, int],
     ``data.engine`` is deliberately NOT part of the key: thread and
     process engines feed byte-identical programs (the engine-invariance
     twins the verifier pins), so their FLOPs must be one entry.
+    ``mesh.partition`` IS: a zero1 step is a different compiled program
+    (per-shard optimizer-slot arguments, reduce-scatter/all-gather
+    structure), so its space budget must never be read as the
+    replicated twin's.
     """
     m = cfg.model
     name = m.name if m.name != "resnet" else f"rn{m.resnet_size}"
@@ -142,8 +146,11 @@ def train_program_key(cfg, mesh_shape: Dict[str, int],
         name = f"wrn{m.resnet_size}_{m.width_multiplier}"
     dtype = {"bfloat16": "bf16", "float32": "f32"}.get(
         m.compute_dtype, m.compute_dtype)
+    partition = getattr(getattr(cfg, "mesh", None), "partition",
+                        "replicated")
     variant = ("_fused" if m.fused_blocks else "") + \
-              ("_remat" if m.remat else "")
+              ("_remat" if m.remat else "") + \
+              (f"_{partition}" if partition != "replicated" else "")
     return (f"{kind}|{cfg.data.dataset}_{name}_{dtype}{variant}"
             f"|mesh{mesh_shape.get('data', 1)}x{mesh_shape.get('model', 1)}"
             f"|b{cfg.train.global_batch_size}")

@@ -8,6 +8,12 @@ from tpu_resnet.parallel.mesh import (
     staged_batch_sharding,
 )
 from tpu_resnet.parallel.multihost import initialize, is_primary
+from tpu_resnet.parallel.partition import (
+    PARTITION_MODES,
+    StatePartitioner,
+    check_partition_mode,
+    make_partitioner,
+)
 
 __all__ = [
     "batch_sharding",
@@ -19,4 +25,8 @@ __all__ = [
     "staged_batch_sharding",
     "initialize",
     "is_primary",
+    "PARTITION_MODES",
+    "StatePartitioner",
+    "check_partition_mode",
+    "make_partitioner",
 ]

@@ -59,11 +59,20 @@ def staged_batch_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
-    """Per-process batch for the host input pipeline."""
+    """Per-process batch for the host input pipeline.
+
+    The mesh carries the full divisibility story: the global batch must
+    split evenly over the processes feeding it AND over the mesh's
+    ``data`` axis consuming it — a batch that divides the process count
+    but not the data axis would pass here and then die later inside jit
+    with an opaque sharding error, so both are checked up front with the
+    mesh named in the message."""
     n_proc = jax.process_count()
     if global_batch % n_proc:
         raise ValueError(
-            f"global batch {global_batch} not divisible by {n_proc} processes")
+            f"global batch {global_batch} not divisible by {n_proc} "
+            f"processes (mesh {dict(mesh.shape)})")
+    check_divisible(global_batch, mesh)
     return global_batch // n_proc
 
 

@@ -80,16 +80,12 @@ class CheckpointBackend:
     """Live weights from ``cfg.train.train_dir`` with hot-reload."""
 
     def __init__(self, cfg: RunConfig, mesh=None):
-        import jax
-        import jax.numpy as jnp
-
         from tpu_resnet import parallel
         from tpu_resnet.serve.infer import make_serve_infer
-        from tpu_resnet.train import schedule as sched_lib
         from tpu_resnet.train.checkpoint import (CheckpointManager,
                                                  CheckpointPoller,
-                                                 latest_step_in)
-        from tpu_resnet.train.state import init_state
+                                                 latest_step_in,
+                                                 partitioned_template)
 
         self._cfg = cfg
         self.num_classes = cfg.data.num_classes
@@ -99,24 +95,16 @@ class CheckpointBackend:
         self.reloads = 0
         if mesh is None:
             mesh = parallel.create_mesh(cfg.mesh)
-        from tpu_resnet.models import build_model
-
-        model = build_model(cfg)
-        schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
-        size = self.image_size
-        # Abstract restore template: the checkpoint manager only needs
-        # shapes/dtypes/shardings, so eval_shape builds it without ever
-        # allocating device buffers — a long-lived server must not pin a
-        # whole extra TrainState (params + optimizer slots) in HBM just
-        # to describe what restore should produce.
-        abstract = jax.eval_shape(
-            lambda: init_state(model, cfg.optim, schedule,
-                               jax.random.PRNGKey(0),
-                               jnp.zeros((1, size, size, 3))))
-        sharding = parallel.replicated(mesh)
-        self._template = jax.tree_util.tree_map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                           sharding=sharding), abstract)
+        # Abstract restore template in the run's partition layout
+        # (train.checkpoint.partitioned_template): the checkpoint
+        # manager only needs shapes/dtypes/shardings, so no device
+        # buffer is ever allocated for it — a long-lived server must not
+        # pin a whole extra TrainState in HBM just to describe what
+        # restore should produce — and a zero1 training run's
+        # checkpoints restore straight into their optimizer-slot shards
+        # (inference reads only params/batch_stats, replicated under
+        # every partition mode).
+        self._template = partitioned_template(cfg, mesh)
         self._ckpt = CheckpointManager(cfg.train.train_dir,
                                        keep=cfg.train.keep_checkpoints)
         self._poller = CheckpointPoller(cfg.train.train_dir)

@@ -65,6 +65,15 @@ Checks:
              run with an injected RESOURCE_EXHAUSTED must die loudly
              AND leave a schema-valid oom_report.json with a live-array
              census (docs/OBSERVABILITY.md)
+  partition_probe  optional (--partition-probe): ZeRO-1 state-partitioner
+             drill (tpu_resnet/parallel/{partition,zero}.py) on the
+             8-device fakepod — a replicated tiny train and its zero1
+             twin must both complete (the zero1 run through an injected
+             SIGTERM + exact-step resume), the zero1 ledger's
+             optimizer-slot argument bytes must be < 0.3x the
+             replicated twin's with the donation credit intact, and
+             tools/perfwatch.py must ingest the probe's peak-HBM
+             numbers as a lower-is-better series (docs/PARALLELISM.md)
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -780,6 +789,142 @@ def _check_mem_probe(timeout: int = 300) -> dict:
                 "oom_census_bytes": census.get("total_bytes")}
 
 
+def _check_partition_probe(timeout: int = 420) -> dict:
+    """ZeRO-1 state-partitioner drill on the 8-device fakepod, scrubbed
+    CPU children (tiny MLP, momentum slots, global batch 16 over an
+    8-way data axis):
+
+    1. a replicated train completes and writes its memory.json ledger
+       entry — the twin baseline;
+    2. the SAME config under ``mesh.partition=zero1`` is preempted by an
+       injected SIGTERM (must exit with the preemption code, checkpoint
+       at the stop step) and a second run must resume to completion —
+       cross-replica optimizer sharding has to survive the save/restore
+       boundary, not just a fresh start;
+    3. the zero1 ledger entry's ``opt_state_argument_bytes`` must be
+       < 0.3x the replicated twin's (the ~1/8 cut of arXiv:2004.13336
+       with generous slack) with the donation credit intact;
+    4. ``tools/perfwatch.py --sweep`` must ingest both runs' peak-HBM
+       numbers as the lower-is-better ``sweep-mem:`` series, so the
+       memory win is a TRACKED trajectory, not a one-shot assertion."""
+    import tempfile
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess
+    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
+
+    overrides = ["train.train_steps=40", "train.checkpoint_every=10",
+                 "train.log_every=10", "train.summary_every=20",
+                 "train.image_summary_every=0", "train.steps_per_call=5",
+                 "train.global_batch_size=16", "model.name=mlp",
+                 "data.device_resident=off", "data.transfer_stage=1"]
+
+    def _ledger_entry(d):
+        with open(os.path.join(d, "memory.json")) as f:
+            entries = json.load(f).get("entries", {})
+        for key, e in sorted(entries.items()):
+            if "opt_state_argument_bytes" in e:
+                return key, e
+        return None, None
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_part_") as d:
+        rep_dir = os.path.join(d, "replicated")
+        z_dir = os.path.join(d, "zero1")
+        rc_rep, out = run_scrubbed_subprocess(
+            [sys.executable, "-m", "tpu_resnet", "train",
+             "--preset", "smoke", f"train.train_dir={rep_dir}"]
+            + overrides, n_devices=8, timeout=timeout)
+        if rc_rep != 0:
+            return {"ok": False, "phase": "replicated", "rc": rc_rep,
+                    "tail": out.strip().splitlines()[-5:]}
+        zcmd = [sys.executable, "-m", "tpu_resnet", "train",
+                "--preset", "smoke", f"train.train_dir={z_dir}",
+                "mesh.partition=zero1"] + overrides
+        rc1, out1 = run_scrubbed_subprocess(
+            zcmd + ["resilience.inject_sigterm_at_step=20"],
+            n_devices=8, timeout=timeout)
+        # z_dir is created by the CHILD (first artifact write): a child
+        # that dies at startup — a partitioner regression raising before
+        # any directory exists — must be a structured failure report,
+        # not a doctor FileNotFoundError.
+        steps = (sorted(int(n) for n in os.listdir(z_dir) if n.isdigit())
+                 if os.path.isdir(z_dir) else [])
+        if rc1 != PREEMPT_EXIT_CODE or 20 not in steps:
+            return {"ok": False, "phase": "zero1_preempt", "rc": rc1,
+                    "expected_rc": PREEMPT_EXIT_CODE, "ckpt_steps": steps,
+                    "tail": out1.strip().splitlines()[-5:]}
+        rc2, out2 = run_scrubbed_subprocess(zcmd, n_devices=8,
+                                            timeout=timeout)
+        if rc2 != 0:
+            return {"ok": False, "phase": "zero1_resume", "rc": rc2,
+                    "tail": out2.strip().splitlines()[-5:]}
+        try:
+            rep_key, rep = _ledger_entry(rep_dir)
+            z_key, z = _ledger_entry(z_dir)
+        except (OSError, ValueError) as e:
+            return {"ok": False, "phase": "ledger",
+                    "error": f"memory.json unreadable: {e}"}
+        if rep is None or z is None:
+            return {"ok": False, "phase": "ledger",
+                    "error": "ledger entry with the optimizer-slot "
+                             "breakdown missing",
+                    "replicated_key": rep_key, "zero1_key": z_key}
+        rep_opt = int(rep.get("opt_state_argument_bytes", 0))
+        z_opt = int(z.get("opt_state_argument_bytes", 0))
+        ratio = z_opt / rep_opt if rep_opt else float("inf")
+        result = {"replicated_key": rep_key, "zero1_key": z_key,
+                  "opt_bytes_replicated": rep_opt,
+                  "opt_bytes_zero1": z_opt,
+                  "opt_ratio": round(ratio, 4),
+                  "zero1_alias_bytes": int(z.get("alias_bytes", 0)),
+                  "preempt_rc": rc1, "resume_rc": rc2,
+                  "ckpt_at_stop": 20}
+        if not (0 < z_opt and ratio < 0.3 and z.get("alias_bytes", 0) > 0):
+            result.update(ok=False, phase="opt_bytes",
+                          error="zero1 optimizer-slot argument bytes not "
+                                "< 0.3x the replicated twin's with "
+                                "donation intact")
+            return result
+
+        # perfwatch ingestion: the probe's peak-HBM per partition mode as
+        # a sweep-style trajectory — perfwatch's sweep-mem machinery then
+        # tracks it lower-is-better across probe runs. Skipped on an
+        # installed wheel without tools/.
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        script = os.path.join(root, "tools", "perfwatch.py")
+        if os.path.exists(script):
+            traj = {"metric": "partition_probe_hbm", "backend": "cpu",
+                    "points": [
+                        {"id": f"partition={name}", "status": "ok",
+                         "backend": "cpu", "steps_per_sec": 1.0,
+                         "hbm_bytes_peak": int(e.get("peak_bytes", 0))}
+                        for name, e in (("replicated", rep), ("zero1", z))
+                        if e.get("peak_bytes")]}
+            traj_path = os.path.join(d, "partition_probe_sweep.json")
+            with open(traj_path, "w") as f:
+                json.dump(traj, f)
+            try:
+                pw = subprocess.run(
+                    [sys.executable, script, "--sweep", traj_path],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, timeout=60)
+            except subprocess.TimeoutExpired:
+                result.update(ok=False, perfwatch="hung")
+                return result
+            ingested = all(f"sweep-mem:partition={n}" in pw.stdout
+                           for n in ("replicated", "zero1"))
+            result["perfwatch_ingested"] = ingested
+            if pw.returncode != 0 or not ingested:
+                result.update(ok=False, phase="perfwatch",
+                              perfwatch_tail=pw.stdout.strip()
+                              .splitlines()[-5:])
+                return result
+        else:
+            result["perfwatch_ingested"] = "skipped (no tools/perfwatch.py)"
+        result["ok"] = True
+        return result
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -826,7 +971,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                check_matrix: bool = True, serve_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
-               stream=None) -> dict:
+               partition_probe: bool = False, stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -874,6 +1019,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if mem_probe:
         summary["mem_probe"] = _check_mem_probe()
         emit("mem_probe", summary["mem_probe"])
+    if partition_probe:
+        summary["partition_probe"] = _check_partition_probe()
+        emit("partition_probe", summary["partition_probe"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

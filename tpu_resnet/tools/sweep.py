@@ -74,6 +74,12 @@ DEFAULT_SPACE: Dict[str, list] = {
     "fused": [False, True],        # model.fused_blocks
     "remat": [False, True],
     "batch": [128, 256],
+    # mesh.partition (parallel/partition.py): zero1 trades an all-gather
+    # of the updated params per step for ~Nx less optimizer HBM — a
+    # throughput/memory knob, judged like every other point (perfwatch
+    # gates its hbm_bytes_peak as lower-is-better). Identity on a 1-way
+    # data axis.
+    "partition": ["replicated", "zero1"],
 }
 
 
@@ -176,6 +182,7 @@ def point_config(knobs: Dict, args) -> "object":
     cfg.data.prefetch = int(knobs.get("prefetch", 2))
     cfg.data.h2d_double_buffer = bool(knobs.get("h2d", True))
     cfg.data.device_resident = "off"
+    cfg.mesh.partition = str(knobs.get("partition", "replicated"))
     return cfg
 
 
@@ -207,16 +214,23 @@ def measure_point(point: Dict, args) -> Dict:
     knobs = point["knobs"]
     cfg = point_config(knobs, args)
     mesh = parallel.create_mesh(None)
-    parallel.check_divisible(cfg.train.global_batch_size, mesh)
+    batch = cfg.train.global_batch_size
+    # Process + data-axis divisibility in one gate (mesh.py), BEFORE the
+    # compile — a bad batch is a clear ValueError, not a jit error.
+    local_batch = parallel.local_batch_size(batch, mesh)
     state, step_fn, run_staged = build_point_programs(
         cfg, mesh, donate_state=bool(knobs.get("donate", True)))
 
-    batch = cfg.train.global_batch_size
     stage = cfg.data.transfer_stage
     images, labels = synthetic_data(max(args.split, batch), args.image, 10)
+    # Process identity flows from the runtime, not a hardcoded single-
+    # process assumption: under a multiprocess rehearsal (launch/
+    # local_multiprocess.sh) each sweep child feeds only its own stripe
+    # at the per-process batch, exactly like the production pipeline.
     batcher = pipeline.ShardedBatcher(images, labels.astype(np.int32),
-                                      batch, seed=0, process_index=0,
-                                      process_count=1)
+                                      local_batch, seed=0,
+                                      process_index=jax.process_index(),
+                                      process_count=jax.process_count())
     host_iter = pipeline.BackgroundIterator(
         iter(batcher), capacity=max(2, 2 * stage))
     closers = [host_iter.close]

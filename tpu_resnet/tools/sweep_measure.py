@@ -19,17 +19,20 @@ from tpu_resnet import parallel
 from tpu_resnet.data import device_data
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
-from tpu_resnet.train.state import init_state
+from tpu_resnet.train.state import init_partitioned_state
 from tpu_resnet.train.step import (check_step_config, make_train_step,
                                    shard_step)
 
 
 def build_point_programs(cfg, mesh, donate_state: bool = True):
-    """Everything one sweep point compiles: the replicated initial state,
-    the per-batch step (``transfer_stage == 1``) and the staged chunk
-    runner (``transfer_stage > 1``) — the exact program constructors
-    train/loop.py uses, so a sweep point measures the production
-    configuration, not a harness approximation.
+    """Everything one sweep point compiles: the partitioner-placed
+    initial state, the per-batch step (``transfer_stage == 1``) and the
+    staged chunk runner (``transfer_stage > 1``) — the exact program
+    constructors train/loop.py uses, so a sweep point measures the
+    production configuration, not a harness approximation. The point's
+    ``cfg.mesh.partition`` (the sweep's ``partition`` knob) selects the
+    state layout through the same ``parallel.StatePartitioner`` the loop
+    asks.
 
     Returns ``(state, step_fn, run_staged)``.
     """
@@ -38,16 +41,22 @@ def build_point_programs(cfg, mesh, donate_state: bool = True):
     schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
     size = cfg.data.resolved_image_size
     rng = jax.random.PRNGKey(cfg.train.seed)
-    state = init_state(model, cfg.optim, schedule, rng,
-                       jnp.zeros((1, size, size, 3), jnp.float32))
-    state = jax.device_put(state, parallel.replicated(mesh))
+    partitioner = parallel.make_partitioner(cfg.mesh, mesh)
+    state = init_partitioned_state(
+        model, cfg.optim, schedule, rng,
+        jnp.zeros((1, size, size, 3), jnp.float32), partitioner)
     base = make_train_step(model, cfg.optim, schedule,
                            cfg.data.num_classes, None, base_rng=rng,
                            mesh=mesh,
                            xent_probe_batch=max(
                                1, cfg.train.global_batch_size
-                               // mesh.shape["data"]))
-    step_fn = shard_step(base, mesh, donate_state=donate_state)
+                               // mesh.shape["data"]),
+                           partitioner=partitioner)
+    state_sharding = (partitioner.state_shardings(state)
+                      if partitioner.is_sharded else None)
+    step_fn = shard_step(base, mesh, donate_state=donate_state,
+                         state_sharding=state_sharding)
     run_staged = device_data.compile_staged_stream_steps(
-        base, mesh, donate_state=donate_state)
+        base, mesh, donate_state=donate_state,
+        state_sharding=state_sharding)
     return state, step_fn, run_staged

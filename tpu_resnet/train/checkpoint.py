@@ -153,6 +153,44 @@ class CheckpointManager:
         self._mgr.close()
 
 
+def partitioned_template(cfg, mesh, model=None):
+    """Abstract TrainState restore template laid out by the run's
+    partitioner — the ONE way every read-only consumer (eval sidecar,
+    serve hot-reload, export) describes what restore should produce.
+
+    Built with ``jax.eval_shape`` + sharded ShapeDtypeStructs, so no
+    device buffer is ever allocated for the template itself, and orbax
+    restores each leaf STRAIGHT into the layout ``cfg.mesh.partition``
+    declares: a zero1 checkpoint restores into its optimizer-slot
+    shards without materializing a replicated copy on any device.
+
+    Cross-partition restores are an EXPLICIT reshard, never a silent
+    corruption: orbax checkpoints store global logical arrays (layout-
+    free), so restoring a zero1-saved checkpoint into a replicated
+    template (or vice versa) reassembles the same global values in the
+    template's layout — pinned by tests/test_partition.py. A partition
+    mode the partitioner cannot satisfy on this mesh raises its
+    per-leaf ``validate`` error here, before any restore I/O."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet import parallel
+    from tpu_resnet.models import build_model
+    from tpu_resnet.train import schedule as sched_lib
+    from tpu_resnet.train.state import init_state
+
+    if model is None:
+        model = build_model(cfg)
+    schedule = sched_lib.build_schedule(cfg.optim, cfg.train)
+    size = cfg.data.resolved_image_size
+    abstract = jax.eval_shape(
+        lambda: init_state(model, cfg.optim, schedule,
+                           jax.random.PRNGKey(0),
+                           jnp.zeros((1, size, size, 3))))
+    partitioner = parallel.make_partitioner(cfg.mesh, mesh)
+    return partitioner.abstract_state(abstract)
+
+
 def latest_step_in(directory: str) -> Optional[int]:
     """Cheap latest-checkpoint probe for pollers (the eval sidecar's analog
     of ``tf.train.get_checkpoint_state``, resnet_cifar_eval.py:102)."""

@@ -35,7 +35,7 @@ from tpu_resnet.tools import profiling
 from tpu_resnet.train import schedule as sched_lib
 from tpu_resnet.train.checkpoint import CheckpointManager
 from tpu_resnet.train.metrics_io import MetricsWriter, ThroughputMeter
-from tpu_resnet.train.state import init_state, param_count
+from tpu_resnet.train.state import init_partitioned_state, param_count
 from tpu_resnet.train.step import (check_step_config, make_train_step,
                                    shard_step)
 
@@ -162,12 +162,15 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
     init_rng, step_rng = jax.random.split(rng)
     size = cfg.data.resolved_image_size
     sample = jnp.zeros((1, size, size, 3), jnp.float32)
-    # Init on this process's first local device (jax.devices()[0] may be a
-    # non-addressable remote device on non-primary hosts).
-    with jax.default_device(jax.local_devices()[0]):
-        state = init_state(model, cfg.optim, schedule, init_rng, sample)
-    # Replicate state across the mesh.
-    state = jax.device_put(state, parallel.replicated(mesh))
+    # The partitioner (parallel/partition.py) owns every TrainState
+    # sharding decision: cfg.mesh.partition=replicated reproduces the
+    # historical full-copy device_put; zero1 validates the rule set
+    # against the real state tree (must-raise on unshardable leaves,
+    # BEFORE any compile is paid) and lands the optimizer slots in their
+    # data-axis shards.
+    partitioner = parallel.make_partitioner(cfg.mesh, mesh)
+    state = init_partitioned_state(model, cfg.optim, schedule, init_rng,
+                                   sample, partitioner)
     n_params = param_count(state.params)
 
     # Observability (tpu_resnet/obs): event spans + run manifest + the
@@ -276,7 +279,13 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                                     grad_axis="data" if per_replica_bn else None,
                                     xent_probe_batch=max(
                                         1, cfg.train.global_batch_size
-                                        // mesh.shape["data"]))
+                                        // mesh.shape["data"]),
+                                    partitioner=partitioner)
+        # zero1 compiles with the partitioner's state layout so the
+        # optimizer-slot arguments are per-shard buffers; replicated
+        # passes None and keeps the exact historical program.
+        state_sharding = (partitioner.state_shardings(state)
+                          if partitioner.is_sharded else None)
         if parallel.is_primary() and ops.autotune.decisions():
             # The run's dispatch choices as a reviewable artifact.
             ops.autotune.dump(cfg.train.train_dir)
@@ -298,7 +307,8 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                                            seed=cfg.train.seed)
             run_chunk = device_data.compile_resident_steps(
                 base_step, ds, mesh, max(1, cfg.train.steps_per_call),
-                per_replica_bn=per_replica_bn)
+                per_replica_bn=per_replica_bn,
+                state_sharding=state_sharding)
             data_iter = None
         else:
             data_iter, stage, host_iter = build_train_iterator(
@@ -306,17 +316,20 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 stop_event=shutdown.event)
             if stage > 1:
                 run_staged = device_data.compile_staged_stream_steps(
-                    base_step, mesh, per_replica_bn=per_replica_bn)
+                    base_step, mesh, per_replica_bn=per_replica_bn,
+                    state_sharding=state_sharding)
             else:
                 train_step = shard_step(base_step, mesh,
-                                        per_replica_bn=per_replica_bn)
+                                        per_replica_bn=per_replica_bn,
+                                        state_sharding=state_sharding)
 
         meter = ThroughputMeter(cfg.train.global_batch_size,
                                 num_chips=mesh.size)
         log.info("training %s/%s to step %d | params %.2fM | mesh %s | "
-                 "global batch %d | input %s", cfg.model.name, cfg.data.dataset,
+                 "partition %s | global batch %d | input %s",
+                 cfg.model.name, cfg.data.dataset,
                  total, n_params / 1e6, dict(mesh.shape),
-                 cfg.train.global_batch_size,
+                 partitioner.describe(), cfg.train.global_batch_size,
                  "device-resident" if resident else "streaming")
 
         profiling.maybe_start_server(cfg.train.profiler_port)
@@ -459,6 +472,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                         entry = obs.memory.account_train_step(
                             cfg, mesh, state, base_step,
                             per_replica_bn=per_replica_bn,
+                            partitioner=partitioner,
                             stage_rows=stage if staged_run else 1,
                             chunk_steps=(max(1, cfg.train.steps_per_call)
                                          if staged_run else 1),

@@ -50,5 +50,25 @@ def init_state(model, optim_cfg, schedule, rng: jax.Array,
     return TrainState.create(params, batch_stats, tx)
 
 
+def init_partitioned_state(model, optim_cfg, schedule, rng: jax.Array,
+                           sample_batch: jnp.ndarray,
+                           partitioner) -> TrainState:
+    """Init + validate + place: the partitioner
+    (``parallel.StatePartitioner``) owns where every leaf of the fresh
+    state lives on the mesh — replicated mode reproduces the historical
+    ``device_put(state, replicated(mesh))`` exactly; zero1 lands the
+    optimizer slots directly in their shards. ``validate`` runs the full
+    rule set against the real state tree FIRST, so an unshardable
+    (model × mesh × partition) combination dies with per-leaf messages
+    before any device transfer or compile is paid.
+
+    Init runs on this process's first local device (``jax.devices()[0]``
+    may be a non-addressable remote device on non-primary hosts)."""
+    with jax.default_device(jax.local_devices()[0]):
+        state = init_state(model, optim_cfg, schedule, rng, sample_batch)
+    partitioner.validate(state)
+    return partitioner.shard_state(state)
+
+
 def param_count(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))

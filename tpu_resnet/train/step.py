@@ -74,7 +74,19 @@ def check_step_config(cfg, data_axis: int) -> None:
     sync-BN under auto-sharded jit is not implemented for the fused
     custom call: fail loudly rather than ship unclear moment semantics
     (VERDICT r4 item 5)."""
+    from tpu_resnet.parallel.partition import check_partition_mode
+
     per_replica_bn = (not cfg.model.sync_bn) and data_axis > 1
+    partition = check_partition_mode(
+        getattr(cfg.mesh, "partition", "replicated"))
+    if partition == "zero1" and per_replica_bn:
+        raise ValueError(
+            "mesh.partition=zero1 on a multi-chip data axis requires "
+            "model.sync_bn=true: per-replica BN runs the step inside "
+            "shard_map, where the zero1 sharding annotations "
+            "(with_sharding_constraint over the mesh) cannot be applied "
+            "— the auto-sharded jit path is the supported dispatch for "
+            "cross-replica optimizer sharding (docs/PARALLELISM.md)")
     if cfg.model.fused_blocks and data_axis > 1 and not per_replica_bn:
         raise ValueError(
             "model.fused_blocks on a multi-chip data axis requires "
@@ -95,7 +107,8 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
                     base_rng: Optional[jax.Array] = None,
                     mesh: Optional[Mesh] = None,
                     grad_axis: Optional[str] = None,
-                    xent_probe_batch: int = 128):
+                    xent_probe_batch: int = 128,
+                    partitioner=None):
     """Returns ``train_step(state, images, labels) -> (state, metrics)``.
 
     ``images`` may be raw uint8 (augment_fn applied on device) or
@@ -108,8 +121,16 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
     BN stats are explicitly ``pmean``-ed across the axis. When None (the
     default), the step runs under auto-sharded ``jit`` and BN moments are
     global-batch (synced BN); XLA inserts the gradient all-reduces.
+
+    ``partitioner`` (parallel.StatePartitioner) owns the weight-update
+    sharding: zero1 pins the optimizer step to the slot shards
+    (parallel/zero.py); None or replicated traces the identical plain
+    optax chain this function always inlined.
     """
+    from tpu_resnet.parallel import zero
+
     tx = build_optimizer(optim_cfg, schedule)
+    apply_update = zero.make_update_fn(tx, partitioner)
     if base_rng is None:
         base_rng = jax.random.PRNGKey(0)
 
@@ -176,9 +197,8 @@ def make_train_step(model, optim_cfg, schedule, num_classes: int,
             new_batch_stats = jax.lax.pmean(new_batch_stats, grad_axis)
             loss = jax.lax.pmean(loss, grad_axis)
             precision = jax.lax.pmean(precision, grad_axis)
-        updates, new_opt_state = tx.update(grads, state.opt_state,
-                                           state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, new_opt_state = apply_update(grads, state.opt_state,
+                                                 state.params)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -233,10 +253,17 @@ def per_replica_shard_map(fn, mesh: Mesh, in_specs):
 
 
 def shard_step(step_fn, mesh: Mesh, donate_state: bool = True,
-               per_replica_bn: bool = False):
-    """Compile a step for the mesh: batch split over 'data', state
-    replicated. XLA emits the gradient/BN all-reduces over ICI — the entire
-    replacement for ps push/pull + Horovod fusion threads.
+               per_replica_bn: bool = False, state_sharding=None):
+    """Compile a step for the mesh: batch split over 'data', state laid
+    out per the partitioner. XLA emits the gradient/BN all-reduces over
+    ICI — the entire replacement for ps push/pull + Horovod fusion
+    threads.
+
+    ``state_sharding`` is the TrainState-shaped sharding tree from
+    ``StatePartitioner.state_shardings`` (None = fully replicated,
+    today's default — every caller without an opinion keeps the exact
+    historical program). zero1 callers pass their sharded tree so the
+    optimizer-slot arguments compile to per-shard buffers.
 
     ``per_replica_bn=True`` compiles the ``shard_map`` variant: the step
     body (built with ``grad_axis='data'``) sees only its local batch shard,
@@ -249,6 +276,7 @@ def shard_step(step_fn, mesh: Mesh, donate_state: bool = True,
             step_fn, mesh, in_specs=(P(), P("data"), P("data")))
     return jax.jit(
         step_fn,
-        in_shardings=(repl, data, data),
+        in_shardings=(state_sharding if state_sharding is not None
+                      else repl, data, data),
         donate_argnums=(0,) if donate_state else (),
     )
