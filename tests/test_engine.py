@@ -233,9 +233,15 @@ def test_engine_stats_shape(tmp_path):
         next(eng)
         s = eng.stats()
         assert set(s) == {"data_ring_occupancy", "data_ring_slots",
-                          "data_decode_images_per_sec"}
+                          "data_decode_images_per_sec",
+                          "data_stream_seq"}
         assert s["data_ring_slots"] >= 4
         assert s["data_ring_occupancy"] >= 0
+        # One batch consumed from seq 0 → the stream position is 1; a
+        # resumed engine (first_seq=resume step) reports the continued
+        # position, so the gauge tracks the deterministic (seed, step)
+        # stream across elastic reshapes.
+        assert s["data_stream_seq"] == 1.0
     finally:
         eng.close()
 

@@ -8,6 +8,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_resnet.config import load_config
 from tpu_resnet.data.cifar import synthetic_data
@@ -88,6 +89,9 @@ def test_export_from_checkpoint_end_to_end(tmp_path):
     assert os.path.exists(os.path.join(pred_out, "mispredictions.png"))
 
 
+@pytest.mark.slow  # 21s: runs a full train() just to list arrays; the
+# export e2e sibling (same train+checkpoint path) stays tier-1 — budget
+# precedent (PR1-7)
 def test_inspect_checkpoint(tmp_path, capsys):
     from tpu_resnet.tools.inspect_ckpt import list_arrays, main as inspect_main
 

@@ -79,6 +79,9 @@ def test_train_forward_and_stats_equivalence(setup):
             rtol=1e-4, atol=1e-5, err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow  # 30s: default-OFF feature (model.fused_blocks); the
+# fast forward/stats-equivalence sibling stays tier-1 and the full
+# training-run A/B was already slow — budget precedent (PR1-7)
 def test_train_gradient_equivalence(setup):
     """jax.grad through the custom-VJP fused path vs XLA autodiff — the
     full model loss gradient, every parameter."""
@@ -262,6 +265,9 @@ def test_imagenet_basic_512_stage_stays_xla():
     assert y.shape == x.shape
 
 
+@pytest.mark.slow  # 31s: default-OFF feature; the shard_map 8-device
+# twin is already slow and the single-device equivalence siblings stay
+# tier-1 — budget precedent (PR1-7)
 def test_fused_matches_xla_on_8device_mesh():
     """On the virtual 8-device mesh (interpret-mode kernels lower to
     regular XLA ops) the fused path reproduces the sync-BN XLA path's

@@ -156,6 +156,9 @@ def test_s2d_stem_exactly_matches_plain_stem():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # 21s: default-OFF knob (model.remat); the remat
+# programs are pinned by the config-matrix golden jaxprs and the
+# fused+remat compose drill was already slow — budget precedent (PR1-7)
 def test_remat_matches_plain(
 ):
     """model.remat must not change the function — same params, same

@@ -96,6 +96,9 @@ def test_distinct_shards_diverge_from_global_bn():
     assert not np.allclose(stats[0], stats[1])
 
 
+@pytest.mark.slow  # 30s full train() run; the three per-replica-BN
+# semantics units above stay tier-1 and the config matrix pins the
+# compiled per-replica program — budget precedent (PR1-7)
 def test_train_loop_per_replica_resident(tmp_path):
     """End-to-end: resident input path + shard_map per-replica BN."""
     cfg = load_config("smoke")

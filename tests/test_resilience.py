@@ -309,7 +309,7 @@ def test_supervise_restart_policy():
     codes = iter([42, 1, 1, 42, 0])
     calls, sleeps = [], []
     rc = supervise(["job"], max_restarts=10, backoff_base=1.0,
-                   backoff_cap=4.0, preempt_delay=0.5,
+                   backoff_cap=4.0, preempt_delay=0.5, jitter=False,
                    run=lambda c: (calls.append(list(c)), next(codes))[1],
                    sleep=sleeps.append)
     assert rc == 0
@@ -324,9 +324,32 @@ def test_supervise_backoff_cap_and_give_up():
 
     sleeps = []
     rc = supervise(["job"], max_restarts=5, backoff_base=1.0,
-                   backoff_cap=4.0, run=lambda c: 7, sleep=sleeps.append)
+                   backoff_cap=4.0, jitter=False, run=lambda c: 7,
+                   sleep=sleeps.append)
     assert rc == 7
     assert sleeps == [1.0, 2.0, 4.0, 4.0, 4.0]  # capped, then gives up
+
+
+def test_supervise_crash_backoff_decorrelated_jitter():
+    """Default backoff is decorrelated-jitter (fleet restarts after a
+    shared fault must not stampede): each crash delay is uniform in
+    [base, 3 * previous], capped — and every delay is logged."""
+    import random
+
+    from tools.supervise import supervise
+
+    sleeps = []
+    rc = supervise(["job"], max_restarts=6, backoff_base=1.0,
+                   backoff_cap=40.0, rng=random.Random(7),
+                   run=lambda c: 9, sleep=sleeps.append)
+    assert rc == 9
+    assert len(sleeps) == 6
+    prev = 1.0
+    for d in sleeps:
+        assert 1.0 <= d <= min(40.0, max(1.0, prev) * 3), (d, prev)
+        prev = d
+    # jitter actually jitters: the deterministic schedule is 1,2,4,8...
+    assert sleeps != [1.0, 2.0, 4.0, 8.0, 16.0, 32.0]
 
 
 def test_supervise_cli_requires_command(capsys):

@@ -10,11 +10,22 @@ different policies by exit code:
 - **preempt code** (machine reclaimed, clean save on disk): restart after
   a short fixed delay; these are expected and don't count against the
   crash backoff.
-- **any other nonzero code** (real crash): restart with capped
-  exponential backoff (base · 2^crashes, up to --backoff-cap) so a
-  hard-broken job can't hot-loop the cluster; the crash streak resets on
-  any clean interval.
+- **any other nonzero code** (real crash): restart with capped,
+  decorrelated-jitter exponential backoff (each delay drawn uniformly
+  from [base, 3 · previous], capped at --backoff-cap) so a fleet that
+  shares a fault doesn't stampede the cluster in synchronized restart
+  waves; the crash streak resets on any clean interval. The chosen
+  delay is logged.
 - **0**: done, exit 0.
+
+**Downsize policy** (elastic capacity, resilience/elastic.py): with
+``--downsize-after N --mesh-ladder 4,2``, N preemptions inside
+``--downsize-window`` seconds mean this host's capacity is churning —
+instead of resuming at the same shape and being reclaimed again, the
+next restart appends ``mesh.data=<rung>`` (the next ladder entry) to the
+command, and the trainer's elastic resume reshards the checkpoint onto
+the smaller mesh. Later CLI overrides win in the config system, so the
+appended override takes effect without editing the base command.
 
 Usage:
 
@@ -29,6 +40,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import random
 import subprocess
 import sys
 import time
@@ -62,18 +74,61 @@ def _run_id_of(cmd) -> str:
         return ""
 
 
+class DownsizePolicy:
+    """Restart with a smaller mesh after repeated preemptions.
+
+    ``threshold`` preemptions inside ``window_sec`` pop the next rung of
+    ``ladder`` (data-axis sizes, largest first, e.g. ``(4, 2)``) — the
+    signal that this host's capacity is churning and the run should ride
+    the wave at a smaller shape instead of thrashing at the original
+    one. The preemption history clears on each downsize (the new shape
+    gets a fresh window) and on any crash-free completion. ``clock`` is
+    injectable for tests."""
+
+    def __init__(self, threshold: int, window_sec: float, ladder,
+                 clock=time.time):
+        self.threshold = int(threshold)
+        self.window_sec = float(window_sec)
+        self.ladder = [int(x) for x in ladder]
+        self.clock = clock
+        self.events = []  # preemption timestamps inside the window
+
+    def note_preempt(self):
+        """Record one preemption; returns the new ``mesh.data`` size when
+        the policy triggers, else None."""
+        if self.threshold <= 0:
+            return None
+        now = self.clock()
+        self.events.append(now)
+        self.events = [t for t in self.events
+                       if now - t <= self.window_sec]
+        if len(self.events) >= self.threshold and self.ladder:
+            self.events.clear()
+            return self.ladder.pop(0)
+        return None
+
+
 def supervise(cmd, max_restarts: int = 100, preempt_code: int =
               DEFAULT_PREEMPT_CODE, backoff_base: float = 1.0,
               backoff_cap: float = 300.0, preempt_delay: float = 1.0,
-              run=None, sleep=time.sleep) -> int:
+              jitter: bool = True, rng=None,
+              downsize_after: int = 0, downsize_window: float = 600.0,
+              mesh_ladder=(), run=None, sleep=time.sleep) -> int:
     """Run ``cmd`` under the restart policy; returns the final exit code.
-    ``run``/``sleep`` are injectable for tests."""
+    ``run``/``sleep``/``rng`` are injectable for tests; ``jitter=False``
+    restores the deterministic base·2^crashes schedule."""
     if run is None:
         run = lambda c: subprocess.call(c)  # noqa: E731
+    if rng is None:
+        rng = random.Random()
+    policy = (DownsizePolicy(downsize_after, downsize_window, mesh_ladder)
+              if downsize_after > 0 and mesh_ladder else None)
+    mesh_override = None  # appended last: later config overrides win
     restarts = 0
     crash_streak = 0
+    prev_delay = backoff_base
     while True:
-        rc = run(cmd)
+        rc = run(list(cmd) + ([mesh_override] if mesh_override else []))
         run_id = _run_id_of(cmd)
         if run_id:
             log.info("supervised run_id=%s exited %d", run_id, rc)
@@ -87,17 +142,37 @@ def supervise(cmd, max_restarts: int = 100, preempt_code: int =
         restarts += 1
         if rc == preempt_code:
             crash_streak = 0
+            prev_delay = backoff_base
             delay = preempt_delay
+            rung = policy.note_preempt() if policy is not None else None
+            if rung is not None:
+                mesh_override = f"mesh.data={rung}"
+                log.warning(
+                    "downsize policy: %d preemption(s) within %.0fs — "
+                    "restarting with %s (elastic resume reshards the "
+                    "checkpoint onto the smaller mesh)",
+                    downsize_after, downsize_window, mesh_override)
             log.warning("preempted (exit %d) — resuming from the final "
-                        "checkpoint in %.1fs (restart %d/%d)", rc, delay,
-                        restarts, max_restarts)
+                        "checkpoint in %.1fs (restart %d/%d)%s", rc, delay,
+                        restarts, max_restarts,
+                        f" with {mesh_override}" if mesh_override else "")
         else:
             crash_streak += 1
-            delay = min(backoff_cap,
-                        backoff_base * (2 ** (crash_streak - 1)))
+            if jitter:
+                # Decorrelated jitter: uniform in [base, 3·previous],
+                # capped — a fleet restarting after a shared fault
+                # spreads out instead of stampeding in lockstep.
+                delay = min(backoff_cap,
+                            rng.uniform(backoff_base,
+                                        max(backoff_base, prev_delay) * 3))
+            else:
+                delay = min(backoff_cap,
+                            backoff_base * (2 ** (crash_streak - 1)))
+            prev_delay = delay
             log.warning("crashed (exit %d) — restart %d/%d in %.1fs "
-                        "(crash streak %d)", rc, restarts, max_restarts,
-                        delay, crash_streak)
+                        "(crash streak %d%s)", rc, restarts, max_restarts,
+                        delay, crash_streak,
+                        ", decorrelated jitter" if jitter else "")
         sleep(delay)
 
 
@@ -120,17 +195,40 @@ def main(argv=None) -> int:
                    help="max crash-restart delay, seconds")
     p.add_argument("--preempt-delay", type=float, default=1.0,
                    help="fixed delay before resuming after a preemption")
+    p.add_argument("--no-jitter", action="store_true",
+                   help="disable the decorrelated crash-backoff jitter "
+                        "(deterministic base*2^crashes schedule)")
+    p.add_argument("--downsize-after", type=int, default=0,
+                   help="preemptions inside --downsize-window that "
+                        "trigger a mesh downsize (0 = policy off)")
+    p.add_argument("--downsize-window", type=float, default=600.0,
+                   help="downsize-policy window, seconds")
+    p.add_argument("--mesh-ladder", default="",
+                   help="comma-separated mesh.data sizes to step down "
+                        "through on downsize, largest first (e.g. 4,2)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to supervise (prefix with --)")
     args = p.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         p.error("no command given; usage: supervise.py [options] -- cmd ...")
+    try:
+        ladder = tuple(int(x) for x in args.mesh_ladder.split(",")
+                       if x.strip())
+    except ValueError:
+        p.error(f"--mesh-ladder must be comma-separated integers "
+                f"(e.g. 4,2): {args.mesh_ladder!r}")
+    if args.downsize_after > 0 and not ladder:
+        p.error("--downsize-after requires --mesh-ladder")
     return supervise(cmd, max_restarts=args.max_restarts,
                      preempt_code=args.preempt_code,
                      backoff_base=args.backoff_base,
                      backoff_cap=args.backoff_cap,
-                     preempt_delay=args.preempt_delay)
+                     preempt_delay=args.preempt_delay,
+                     jitter=not args.no_jitter,
+                     downsize_after=args.downsize_after,
+                     downsize_window=args.downsize_window,
+                     mesh_ladder=ladder)
 
 
 if __name__ == "__main__":

@@ -374,6 +374,13 @@ class ResilienceConfig:
     # must write <train_dir>/oom_report.json (ledger, gauge history,
     # live-array census) before re-raising (doctor --mem-probe).
     inject_oom_at_step: int = -1
+    # Preemption burst: K SIGTERMs total ACROSS supervised restarts, each
+    # fired inject_preempt_burst_every steps after its child's first
+    # chunk boundary (count persisted in <train_dir>/fault_burst_state.
+    # json — the firing kills the process that would remember it). The
+    # deterministic drill for tools/supervise.py's downsize policy.
+    inject_preempt_burst: int = 0
+    inject_preempt_burst_every: int = 10
 
 
 @dataclasses.dataclass
@@ -421,6 +428,13 @@ class ServeConfig:
     # Latency ring: recent per-request latencies kept for the p50/p95/p99
     # gauges on /metrics.
     latency_ring: int = 1024
+    # Colocation admission (resilience/elastic.py): estimated HBM bytes
+    # this replica needs (weights + bucket activations). >0 gates startup
+    # on the live device-memory gauges — a replica joining a trainer's
+    # host starts only when the measured headroom fits it (exit code 3
+    # when denied, so a scheduler can tell "no capacity here" from a
+    # crash). 0 = no arbitration (single-tenant hosts).
+    admission_hbm_bytes: int = 0
 
 
 @dataclasses.dataclass

@@ -384,6 +384,14 @@ class HostDataEngine:
             "data_ring_occupancy": float(len(self._ready) + queued),
             "data_ring_slots": float(self.ring_slots),
             "data_decode_images_per_sec": round(rate, 1),
+            # Next work-order sequence the consumer will yield — batch
+            # contents are a pure function of (seed, seq), so this gauge
+            # is the deterministic-stream position. Across an elastic
+            # reshape (resilience/elastic.py) the resumed run's first
+            # logged value must equal resume_step + batches consumed:
+            # the work-order slicing depends only on the per-process
+            # batch (global batch is the invariant), never the mesh.
+            "data_stream_seq": float(self._next_yield),
         }
 
     # -------------------------------------------------------------- close

@@ -158,6 +158,15 @@ def main(argv=None):
                                 "replicated twin's, SIGTERM + exact-step "
                                 "resume under zero1, perfwatch peak-HBM "
                                 "ingestion")
+            p.add_argument("--reshape-drill", action="store_true",
+                           help="elastic-capacity drill (~2min tiny CPU "
+                                "runs): mesh8 train preempted by an "
+                                "injected SIGTERM, resumed on a 4-device "
+                                "child as zero1 — loss stream equal to "
+                                "an uninterrupted mesh8 reference within "
+                                "1e-6 at every logged step, "
+                                "topology_change span recorded, "
+                                "perfwatch ingests pre/post steps/s")
     args = parser.parse_args(argv)
 
     if args.command == "fetch":
@@ -181,7 +190,8 @@ def main(argv=None):
                              perfwatch=args.perfwatch,
                              sweep_probe=args.sweep_probe,
                              mem_probe=args.mem_probe,
-                             partition_probe=args.partition_probe)
+                             partition_probe=args.partition_probe,
+                             reshape_drill=args.reshape_drill)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config

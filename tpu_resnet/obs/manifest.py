@@ -85,14 +85,18 @@ def _git_rev() -> Optional[str]:
     return rev if proc.returncode == 0 and rev else None
 
 
-def build_manifest(cfg, mesh, run_id: Optional[str] = None) -> dict:
-    """Assemble the manifest dict (pure; no filesystem writes)."""
+def build_manifest(cfg, mesh, run_id: Optional[str] = None,
+                   extra: Optional[dict] = None) -> dict:
+    """Assemble the manifest dict (pure; no filesystem writes).
+    ``extra`` top-level entries are merged in — e.g. the elastic-resume
+    ``topology_change`` record (resilience/elastic.py), so a capacity
+    reshape is auditable from the manifest alone."""
     import jax
 
     import tpu_resnet
 
     devices = list(mesh.devices.flat)
-    return {
+    manifest = {
         "schema": SCHEMA_VERSION,
         "run_id": run_id,
         "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -115,10 +119,14 @@ def build_manifest(cfg, mesh, run_id: Optional[str] = None) -> dict:
         "hostname": socket.gethostname(),
         "argv": list(sys.argv),
     }
+    if extra:
+        manifest.update(extra)
+    return manifest
 
 
 def write_manifest(train_dir: str, cfg, mesh,
-                   run_id: Optional[str] = None) -> Optional[str]:
+                   run_id: Optional[str] = None,
+                   extra: Optional[dict] = None) -> Optional[str]:
     """Write ``<train_dir>/manifest.json`` (primary process only; atomic).
     Returns the path, or None on a non-primary process."""
     from tpu_resnet import parallel
@@ -129,7 +137,7 @@ def write_manifest(train_dir: str, cfg, mesh,
     path = os.path.join(train_dir, "manifest.json")
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "w") as f:
-        json.dump(build_manifest(cfg, mesh, run_id=run_id), f, indent=1,
-                  default=list)
+        json.dump(build_manifest(cfg, mesh, run_id=run_id, extra=extra),
+                  f, indent=1, default=list)
     os.replace(tmp, path)
     return path

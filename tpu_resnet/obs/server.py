@@ -93,6 +93,14 @@ CORE_GAUGES = (
     ("fault_nan_rollbacks", "NaN/divergence rollbacks performed"),
     ("fault_watchdog_stalls", "Hang-watchdog stall detections"),
     ("fault_preemptions", "Graceful preemption stops (SIGTERM/SIGINT)"),
+    ("fault_preempt_burst", "Injected preemption-burst SIGTERMs fired "
+                            "so far across supervised restarts "
+                            "(resilience/faultinject.py drill)"),
+    # Elastic capacity (tpu_resnet/resilience/elastic.py): 1 when this
+    # (re)start's mesh/partition differs from the recorded topology —
+    # the gauge twin of the topology_change span/manifest entry.
+    ("topology_changes", "This restart resumed across a mesh/partition "
+                         "reshape (resilience/elastic.py)"),
 )
 
 # Serving-process gauge set (tpu_resnet/serve; docs/SERVING.md). The

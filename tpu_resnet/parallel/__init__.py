@@ -2,6 +2,7 @@ from tpu_resnet.parallel.mesh import (
     batch_sharding,
     check_divisible,
     create_mesh,
+    fit_mesh,
     get_shard_map,
     local_batch_size,
     replicated,
@@ -19,6 +20,7 @@ __all__ = [
     "batch_sharding",
     "check_divisible",
     "create_mesh",
+    "fit_mesh",
     "get_shard_map",
     "local_batch_size",
     "replicated",

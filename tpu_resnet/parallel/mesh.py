@@ -43,6 +43,38 @@ def create_mesh(mesh_cfg=None, devices: Optional[Sequence[jax.Device]] = None
     return Mesh(dev_array, axis_names)
 
 
+def fit_mesh(mesh_cfg, n_devices: int):
+    """``(data, model, downsized)`` axis sizes that actually fit on
+    ``n_devices`` — the elastic-resume primitive (resilience/elastic.py):
+    a run that asked for ``mesh.data=8`` but restarted on a host with 4
+    chips gets the 4-way mesh it CAN have instead of a dead ValueError.
+
+    The ``model`` axis is a hard constraint (its sharded tensors cannot
+    be re-divided without a different partition plan); the ``data`` axis
+    is the elastic one: ``-1`` follows the hardware in both directions
+    (a device count the model axis doesn't divide drops the remainder —
+    7 devices at model=2 train on 6, reported as downsized), an explicit
+    size that no longer fits shrinks to the largest whole multiple the
+    devices support. Growth is never implicit for an explicit ``data``
+    size — the operator asked for that many."""
+    model = getattr(mesh_cfg, "model", 1) if mesh_cfg is not None else 1
+    data = getattr(mesh_cfg, "data", -1) if mesh_cfg is not None else -1
+    if model < 1 or n_devices < model:
+        raise ValueError(
+            f"mesh model axis {model} cannot fit on {n_devices} "
+            f"device(s) — the model axis is not elastic")
+    if data != -1 and data < 1:
+        raise ValueError(
+            f"mesh.data must be -1 (all remaining devices) or >= 1, "
+            f"got {data}")
+    avail = n_devices // model
+    if data == -1:
+        return avail, model, avail * model != n_devices
+    if data <= avail:
+        return data, model, False
+    return avail, model, True
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) axis split over 'data'."""
     return NamedSharding(mesh, P("data"))

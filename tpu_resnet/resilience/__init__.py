@@ -27,15 +27,24 @@ package makes each one a handled path:
                  when progress resumes.
 ``faultinject``  FaultPlan/FaultInjector — deterministic, config/env-driven
                  fault injection (NaN batch at step N, data stall of S
-                 seconds, SIGTERM at step N, checkpoint corruption), off by
+                 seconds, SIGTERM at step N — single or as a supervised
+                 preemption burst, checkpoint corruption), off by
                  default, used by the drill tests and ``doctor
                  --fault-drill`` to prove every recovery path end-to-end.
+``elastic``      topology as a runtime variable — on restart, derive the
+                 mesh from the devices that actually exist (8→4→2 chips,
+                 replicated↔zero1, any direction), restore through the
+                 partitioner template (explicit cross-topology reshard),
+                 record every reshape as a ``topology_change`` span, and
+                 arbitrate train+serve colocation with the live HBM
+                 gauges (``doctor --reshape-drill`` proves the chain).
 
 Checkpoint-level fallback (restore falls back through ``all_steps()`` to
 the newest restorable checkpoint) lives in ``train/checkpoint.py``; the
 input-pipeline liveness fixes live in ``data/pipeline.py``.
 """
 
+from tpu_resnet.resilience import elastic
 from tpu_resnet.resilience.faultinject import (
     FaultInjector,
     FaultPlan,
@@ -59,4 +68,5 @@ __all__ = [
     "Preempted",
     "ShutdownCoordinator",
     "corrupt_checkpoint",
+    "elastic",
 ]

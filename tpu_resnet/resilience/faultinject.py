@@ -23,6 +23,7 @@ the same fault it just survived.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import time
@@ -34,6 +35,12 @@ log = logging.getLogger("tpu_resnet")
 ENV_PREFIX = "TPU_RESNET_FAULT_"
 
 
+# Cross-restart burst bookkeeping: one SIGTERM per supervised child, K
+# total — the count has to survive the very process deaths it causes,
+# so it lives in a train_dir file, not in the injector object.
+BURST_STATE_FILE = "fault_burst_state.json"
+
+
 @dataclasses.dataclass
 class FaultPlan:
     nan_at_step: int = -1        # poison the batch consumed at this step
@@ -42,18 +49,21 @@ class FaultPlan:
     sigterm_at_step: int = -1    # SIGTERM to self at this chunk boundary
     corrupt_ckpt_at_start: bool = False  # corrupt newest ckpt before restore
     oom_at_step: int = -1        # synthetic RESOURCE_EXHAUSTED at boundary
+    preempt_burst: int = 0       # K SIGTERMs total across supervised runs
+    preempt_burst_every: int = 10  # each fires this many steps after start
 
     @property
     def active(self) -> bool:
         return (self.nan_at_step >= 0 or self.sigterm_at_step >= 0
                 or (self.stall_at_step >= 0 and self.stall_seconds > 0)
-                or self.corrupt_ckpt_at_start or self.oom_at_step >= 0)
+                or self.corrupt_ckpt_at_start or self.oom_at_step >= 0
+                or self.preempt_burst > 0)
 
     @classmethod
     def from_config(cls, resilience_cfg, env=None) -> "FaultPlan":
         """Config fields overridden by ``TPU_RESNET_FAULT_*`` env vars:
         NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT,
-        OOM_STEP."""
+        OOM_STEP, PREEMPT_BURST, PREEMPT_BURST_EVERY."""
         env = os.environ if env is None else env
         r = resilience_cfg
 
@@ -71,19 +81,30 @@ class FaultPlan:
                 "CORRUPT_CKPT", r.inject_corrupt_ckpt,
                 lambda v: v.lower() in ("1", "true", "yes")),
             oom_at_step=pick("OOM_STEP", r.inject_oom_at_step, int),
+            preempt_burst=pick("PREEMPT_BURST",
+                               r.inject_preempt_burst, int),
+            preempt_burst_every=pick("PREEMPT_BURST_EVERY",
+                                     r.inject_preempt_burst_every, int),
         )
 
 
 class FaultInjector:
-    """Applies a :class:`FaultPlan`, once per fault, at exact steps."""
+    """Applies a :class:`FaultPlan`, once per fault, at exact steps.
 
-    def __init__(self, plan: FaultPlan):
+    ``train_dir`` anchors the cross-restart state of the preemption
+    burst (each burst SIGTERM kills this process; the K-of-N count must
+    outlive it)."""
+
+    def __init__(self, plan: FaultPlan, train_dir: str = None):
         self.plan = plan
+        self.train_dir = train_dir
         self._nan_fired = False
         self._stall_fired = False
         self._sigterm_fired = False
         self._corrupt_fired = False
         self._oom_fired = False
+        self._burst_start_step = None  # first boundary this process saw
+        self._burst_spent = False      # caches fired >= K (no re-reads)
         if plan.active:
             log.warning("FAULT INJECTION ACTIVE: %s", plan)
 
@@ -129,6 +150,69 @@ class FaultInjector:
 
             log.warning("injecting SIGTERM at step %d", step)
             os.kill(os.getpid(), signal.SIGTERM)
+        self._maybe_burst_sigterm(step)
+
+    # ------------------------------------------------- preemption burst
+    @property
+    def burst_fired(self) -> int:
+        """SIGTERMs the burst has delivered so far, across restarts (the
+        ``fault_preempt_burst`` gauge value)."""
+        if self.plan.preempt_burst <= 0 or not self.train_dir:
+            return 0
+        try:
+            with open(os.path.join(self.train_dir, BURST_STATE_FILE)) as f:
+                return int(json.load(f).get("fired", 0))
+        except (OSError, ValueError):
+            return 0
+
+    def _maybe_burst_sigterm(self, step: int) -> None:
+        """K SIGTERMs spaced S steps apart ACROSS the supervise restart
+        loop: each supervised child preempts itself S steps after its
+        first chunk boundary until K rounds have fired in total — the
+        deterministic drill for the supervisor's downsize policy. The
+        fired-count lives in ``<train_dir>/fault_burst_state.json``
+        because each firing kills the process that would have
+        remembered it; only the PRIMARY process advances the counter
+        (the same writer discipline as every shared-train_dir artifact),
+        while every process still SIGTERMs itself off the shared count —
+        one counted round per supervised restart, any process count."""
+        if self.plan.preempt_burst <= 0 or self._sigterm_fired \
+                or self._burst_spent or not self.train_dir:
+            return
+        if self._burst_start_step is None:
+            self._burst_start_step = step
+        if step < self._burst_start_step + self.plan.preempt_burst_every:
+            return
+        fired = self.burst_fired
+        if fired >= self.plan.preempt_burst:
+            self._burst_spent = True  # never re-read the file per boundary
+            return
+        self._sigterm_fired = True  # at most one per child, either path
+        try:
+            from tpu_resnet import parallel
+
+            primary = parallel.is_primary()
+        except Exception:  # noqa: BLE001 - jax-free drill harnesses
+            primary = True
+        if primary:
+            path = os.path.join(self.train_dir, BURST_STATE_FILE)
+            try:
+                os.makedirs(self.train_dir, exist_ok=True)
+                tmp = path + f".tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"fired": fired + 1,
+                               "of": self.plan.preempt_burst}, f)
+                os.replace(tmp, path)
+            except OSError as e:
+                log.warning("preempt burst: could not persist state (%s) "
+                            "— not firing (an unbounded burst would "
+                            "never converge)", e)
+                return
+        import signal
+
+        log.warning("injecting preemption burst SIGTERM %d/%d at step %d",
+                    fired + 1, self.plan.preempt_burst, step)
+        os.kill(os.getpid(), signal.SIGTERM)
 
     def maybe_oom(self, step: int) -> None:
         """Raise a synthetic RESOURCE_EXHAUSTED at the first chunk

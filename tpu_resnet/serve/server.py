@@ -411,6 +411,23 @@ def serve(cfg: RunConfig) -> int:
     # trace-export renders one correlated session.
     spans = SpanTracer(cfg.train.train_dir, filename=SERVE_EVENTS_FILE,
                        run_id=read_run_id(cfg.train.train_dir))
+    if cfg.serve.admission_hbm_bytes > 0:
+        # Colocation admission (resilience/elastic.py): a replica
+        # joining a trainer's host starts only when the live HBM gauges
+        # say its estimated footprint fits the measured headroom. Exit
+        # code 3 is the scheduler-facing "no capacity here" — distinct
+        # from a crash, so a placement loop tries another host instead
+        # of backing off on this one.
+        from tpu_resnet.resilience import elastic
+
+        verdict = elastic.colocation_admission(cfg.serve.admission_hbm_bytes)
+        spans.event("colocation_admission", **verdict)
+        if not verdict["admit"]:
+            log.error("serve: colocation admission denied — %s",
+                      verdict["reason"])
+            spans.close()
+            return 3
+        log.info("serve: colocation admission ok — %s", verdict["reason"])
     server = PredictServer(cfg, spans=spans)
     clean = True
     with coordinator:

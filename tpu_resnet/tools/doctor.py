@@ -74,6 +74,15 @@ Checks:
              replicated twin's with the donation credit intact, and
              tools/perfwatch.py must ingest the probe's peak-HBM
              numbers as a lower-is-better series (docs/PARALLELISM.md)
+  reshape_drill  optional (--reshape-drill): elastic-capacity drill
+             (tpu_resnet/resilience/elastic.py) — a mesh8 train is
+             preempted by an injected SIGTERM and resumed in a child
+             with only FOUR devices under mesh.partition=zero1; the
+             resumed loss stream must equal an uninterrupted mesh8
+             reference within 1e-6 at every logged step, a
+             topology_change span must land on the run timeline, and
+             perfwatch must ingest the pre/post steps/s (post
+             normalized by the device ratio) as a tracked series
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -925,6 +934,168 @@ def _check_partition_probe(timeout: int = 420) -> dict:
         return result
 
 
+def _check_reshape_drill(timeout: int = 480) -> dict:
+    """Elastic-capacity drill (tpu_resnet/resilience/elastic.py),
+    scrubbed-CPU children (tiny MLP, global batch 16):
+
+    1. a reference run trains straight through 40 steps on the 8-device
+       fakepod — the loss stream the reshaped run must reproduce;
+    2. an elastic run on the same config is preempted by an injected
+       SIGTERM at step 20 (must exit with the preemption code, step-20
+       checkpoint on disk), then resumed in a child that only has FOUR
+       devices under ``mesh.partition=zero1`` — mesh8→mesh4 AND
+       replicated→zero1 in one restore, through the partitioner
+       template's explicit cross-topology reshard;
+    3. the resumed run must finish, its metrics.jsonl loss stream must
+       equal the reference's within 1e-6 at EVERY logged step (the
+       deterministic (seed, step) contract across the reshape), a
+       ``topology_change`` span must sit on the events.jsonl timeline
+       (trace-export's capacity-wave lane) and topology.json must
+       record the new shape;
+    4. ``tools/perfwatch.py --sweep`` must ingest the drill's pre/post
+       steps/s (post normalized by the 8/4 device ratio) — a reshape
+       that silently loses throughput beyond the device ratio becomes a
+       TRACKED regression, not folklore."""
+    import tempfile
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess
+    from tpu_resnet.obs.spans import load_jsonl, load_spans
+    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
+
+    overrides = ["train.train_steps=40", "train.checkpoint_every=10",
+                 "train.log_every=5", "train.summary_every=5",
+                 "train.image_summary_every=0", "train.steps_per_call=5",
+                 "train.global_batch_size=16", "model.name=mlp",
+                 "data.device_resident=off", "data.transfer_stage=1"]
+
+    def _metrics(d):
+        return load_jsonl(os.path.join(d, "metrics.jsonl"), "step")
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_reshape_") as d:
+        ref_dir = os.path.join(d, "reference")
+        e_dir = os.path.join(d, "elastic")
+        rc_ref, out = run_scrubbed_subprocess(
+            [sys.executable, "-m", "tpu_resnet", "train",
+             "--preset", "smoke", f"train.train_dir={ref_dir}"]
+            + overrides, n_devices=8, timeout=timeout)
+        if rc_ref != 0:
+            return {"ok": False, "phase": "reference", "rc": rc_ref,
+                    "tail": out.strip().splitlines()[-5:]}
+        ecmd = [sys.executable, "-m", "tpu_resnet", "train",
+                "--preset", "smoke", f"train.train_dir={e_dir}"] + overrides
+        rc1, out1 = run_scrubbed_subprocess(
+            ecmd + ["resilience.inject_sigterm_at_step=20"],
+            n_devices=8, timeout=timeout)
+        steps = (sorted(int(n) for n in os.listdir(e_dir) if n.isdigit())
+                 if os.path.isdir(e_dir) else [])
+        if rc1 != PREEMPT_EXIT_CODE or 20 not in steps:
+            return {"ok": False, "phase": "preempt", "rc": rc1,
+                    "expected_rc": PREEMPT_EXIT_CODE, "ckpt_steps": steps,
+                    "tail": out1.strip().splitlines()[-5:]}
+        # The reshape: resume the mesh8/replicated checkpoint in a child
+        # that only HAS 4 devices, as zero1.
+        rc2, out2 = run_scrubbed_subprocess(
+            ecmd + ["mesh.partition=zero1"], n_devices=4, timeout=timeout)
+        if rc2 != 0:
+            return {"ok": False, "phase": "reshape_resume", "rc": rc2,
+                    "tail": out2.strip().splitlines()[-5:]}
+
+        ref_loss = {r["step"]: r["loss"] for r in _metrics(ref_dir)
+                    if "loss" in r}
+        e_recs = _metrics(e_dir)
+        e_loss = {r["step"]: r["loss"] for r in e_recs if "loss" in r}
+        if not ref_loss or set(ref_loss) != set(e_loss):
+            return {"ok": False, "phase": "loss_stream",
+                    "error": "logged steps differ across the reshape",
+                    "reference_steps": sorted(ref_loss),
+                    "elastic_steps": sorted(e_loss)}
+        drift = {s: abs(ref_loss[s] - e_loss[s]) for s in ref_loss}
+        worst = max(drift, key=drift.get)
+        if drift[worst] > 1e-6:
+            return {"ok": False, "phase": "loss_stream",
+                    "error": f"loss stream diverged at step {worst}: "
+                             f"|{ref_loss[worst]} - {e_loss[worst]}| = "
+                             f"{drift[worst]:g} > 1e-6"}
+        reshapes = [s for s in load_spans(os.path.join(e_dir,
+                                                       "events.jsonl"))
+                    if s["span"] == "topology_change"]
+        if not (reshapes
+                and reshapes[-1].get("to_mesh", {}).get("data") == 4
+                and reshapes[-1].get("to_partition") == "zero1"
+                and reshapes[-1].get("from_mesh", {}).get("data") == 8):
+            return {"ok": False, "phase": "topology_span",
+                    "error": "topology_change span missing or wrong",
+                    "spans": reshapes}
+        try:
+            with open(os.path.join(e_dir, "topology.json")) as f:
+                topo = json.load(f)
+        except (OSError, ValueError) as e:
+            return {"ok": False, "phase": "topology_record",
+                    "error": f"topology.json unreadable: {e}"}
+        if topo.get("mesh_shape", {}).get("data") != 4 \
+                or topo.get("partition") != "zero1":
+            return {"ok": False, "phase": "topology_record",
+                    "error": "topology.json does not record the "
+                             "post-reshape shape", "topology": topo}
+
+        pre = [r["steps_per_sec"] for r in e_recs
+               if r.get("steps_per_sec") and r["step"] <= 20]
+        post = [r["steps_per_sec"] for r in e_recs
+                if r.get("steps_per_sec") and r["step"] > 20]
+        result = {"loss_steps": len(ref_loss),
+                  "max_loss_drift": drift[worst],
+                  "preempt_rc": rc1, "resume_rc": rc2,
+                  "reshape": reshapes[-1],
+                  "pre_steps_per_sec": round(sum(pre) / len(pre), 3)
+                  if pre else None,
+                  "post_steps_per_sec": round(sum(post) / len(post), 3)
+                  if post else None}
+        # perfwatch ingestion: pre/post throughput as a sweep-style
+        # trajectory, the post point normalized by the device ratio —
+        # "half the chips" legitimately halves steps/s; losing MORE than
+        # that is the regression the tracker should gate. Skipped on an
+        # installed wheel without tools/.
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        script = os.path.join(root, "tools", "perfwatch.py")
+        if os.path.exists(script) and pre and post:
+            ratio = 8 / 4
+            traj = {"metric": "reshape_drill", "backend": "cpu",
+                    "points": [
+                        {"id": "reshape=mesh8_pre", "status": "ok",
+                         "backend": "cpu",
+                         "steps_per_sec": result["pre_steps_per_sec"]},
+                        {"id": "reshape=mesh4_post", "status": "ok",
+                         "backend": "cpu",
+                         "steps_per_sec": round(
+                             result["post_steps_per_sec"] * ratio, 3)}]}
+            traj_path = os.path.join(d, "reshape_drill_sweep.json")
+            with open(traj_path, "w") as f:
+                json.dump(traj, f)
+            try:
+                pw = subprocess.run(
+                    [sys.executable, script, "--sweep", traj_path],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, timeout=60)
+            except subprocess.TimeoutExpired:
+                result.update(ok=False, perfwatch="hung")
+                return result
+            ingested = all(f"sweep:reshape={n}" in pw.stdout
+                           for n in ("mesh8_pre", "mesh4_post"))
+            result["perfwatch_ingested"] = ingested
+            if pw.returncode != 0 or not ingested:
+                result.update(ok=False, phase="perfwatch",
+                              perfwatch_tail=pw.stdout.strip()
+                              .splitlines()[-5:])
+                return result
+        else:
+            result["perfwatch_ingested"] = (
+                "skipped (no tools/perfwatch.py)" if pre and post
+                else "skipped (no throughput samples)")
+        result["ok"] = True
+        return result
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -971,7 +1142,8 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                check_matrix: bool = True, serve_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
-               partition_probe: bool = False, stream=None) -> dict:
+               partition_probe: bool = False, reshape_drill: bool = False,
+               stream=None) -> dict:
     """Run all checks; print human lines to ``stream`` (default stdout),
     return the summary dict (also printed as one final JSON line)."""
     stream = stream or sys.stdout
@@ -1022,6 +1194,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if partition_probe:
         summary["partition_probe"] = _check_partition_probe()
         emit("partition_probe", summary["partition_probe"])
+    if reshape_drill:
+        summary["reshape_drill"] = _check_reshape_drill()
+        emit("reshape_drill", summary["reshape_drill"])
     summary["ok"] = all(v.get("ok", True) for v in summary.values()
                         if isinstance(v, dict))
     print("DOCTOR_JSON: " + json.dumps(summary), file=stream, flush=True)

@@ -21,11 +21,18 @@ import orbax.checkpoint as ocp
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep: int = 5, spans=None):
+    def __init__(self, directory: str, keep: int = 5, spans=None,
+                 topology: Optional[dict] = None):
         """``spans`` (an ``obs.SpanTracer``) records checkpoint_save /
-        checkpoint_restore spans on the run's events.jsonl timeline."""
+        checkpoint_restore spans on the run's events.jsonl timeline.
+        ``topology`` (a ``resilience.elastic`` topology record) names
+        the mesh/partition THIS consumer restores into — joined with the
+        directory's recorded save topology in restore errors, so a
+        template/shard mismatch reads as "saved on mesh8 zero1, you
+        asked for mesh4 replicated", not a raw pytree diff."""
         self.directory = os.path.abspath(directory)
         self._spans = spans
+        self._topology = topology
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -120,7 +127,30 @@ class CheckpointManager:
         raise RuntimeError(
             f"no restorable checkpoint in {self.directory}: all of "
             f"{candidates} failed; newest error: "
-            f"{type(last_err).__name__}: {last_err}") from last_err
+            f"{type(last_err).__name__}: {last_err}"
+            f"{self._topology_hint()}") from last_err
+
+    def _topology_hint(self) -> str:
+        """Topology context for a failed restore: the directory's
+        recorded save topology vs what this consumer asked for. A shard/
+        template mismatch after a capacity change surfaces as an opaque
+        pytree/sharding error without this — naming both topologies
+        turns it into an actionable line (docs/RESILIENCE.md)."""
+        from tpu_resnet.resilience import elastic
+
+        saved = elastic.read_topology(self.directory)
+        if saved is None and self._topology is None:
+            return ""
+        hint = (f"\ncheckpoint topology: {elastic.describe(saved)}"
+                f"\nrequested topology:  {elastic.describe(self._topology)}")
+        if saved and self._topology and any(
+                saved.get(k) != self._topology.get(k)
+                for k in ("mesh_shape", "partition", "global_batch")):
+            hint += ("\nthe topologies differ — an elastic resume "
+                     "reshards through the partitioner template "
+                     "(resilience/elastic.py), but global array shapes "
+                     "and the global batch must stay compatible")
+        return hint
 
     def _discard(self, steps, log) -> None:
         """Remove checkpoints that failed to restore (delete via orbax so
@@ -164,13 +194,17 @@ def partitioned_template(cfg, mesh, model=None):
     declares: a zero1 checkpoint restores into its optimizer-slot
     shards without materializing a replicated copy on any device.
 
-    Cross-partition restores are an EXPLICIT reshard, never a silent
+    Cross-TOPOLOGY restores are an EXPLICIT reshard, never a silent
     corruption: orbax checkpoints store global logical arrays (layout-
     free), so restoring a zero1-saved checkpoint into a replicated
-    template (or vice versa) reassembles the same global values in the
-    template's layout — pinned by tests/test_partition.py. A partition
-    mode the partitioner cannot satisfy on this mesh raises its
-    per-leaf ``validate`` error here, before any restore I/O."""
+    template (or vice versa), or a mesh8-saved checkpoint into a mesh4
+    template (or vice versa — ``mesh`` here is simply the mesh the
+    CURRENT process built over the devices it actually has,
+    resilience/elastic.py), reassembles the same global values in the
+    template's layout — pinned by tests/test_partition.py and the
+    tests/test_elastic.py cross-mesh matrix. A partition mode the
+    partitioner cannot satisfy on this mesh raises its per-leaf
+    ``validate`` error here, before any restore I/O."""
     import jax
     import jax.numpy as jnp
 

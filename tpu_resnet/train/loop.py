@@ -150,8 +150,16 @@ def _local_image_slice(batch, n: int = 4) -> np.ndarray:
 def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
           max_steps: Optional[int] = None):
     """Run training to ``cfg.train.train_steps``; returns the final state."""
+    elastic_ctx = None
     if mesh is None:
-        mesh = parallel.create_mesh(cfg.mesh)
+        # Elastic resume (resilience/elastic.py): derive the mesh from
+        # the devices that actually exist — an explicit mesh.data that no
+        # longer fits downsizes instead of dying, and a topology that
+        # differs from <train_dir>/topology.json becomes a recorded
+        # topology_change (span + manifest entry + gauge) below. A
+        # caller-supplied mesh opts out: the caller owns its topology.
+        elastic_ctx = resilience.elastic.resolve(cfg)
+        mesh = elastic_ctx.mesh
     parallel.check_divisible(cfg.train.global_batch_size, mesh)
 
     model = build_model(cfg)
@@ -184,7 +192,11 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
               else obs.read_run_id(cfg.train.train_dir))
     spans = obs.SpanTracer(cfg.train.train_dir,
                            enabled=parallel.is_primary(), run_id=run_id)
-    obs.write_manifest(cfg.train.train_dir, cfg, mesh, run_id=run_id)
+    obs.write_manifest(
+        cfg.train.train_dir, cfg, mesh, run_id=run_id,
+        extra=({"topology_change": elastic_ctx.attrs()}
+               if elastic_ctx is not None and elastic_ctx.changed
+               else None))
     from tpu_resnet.obs.server import CORE_HISTOGRAMS
     telemetry = obs.TelemetryRegistry(
         stale_after_sec=cfg.train.telemetry_stale_sec,
@@ -214,7 +226,14 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         # Fault-tolerance layer (tpu_resnet/resilience): preemption-graceful
         # shutdown, NaN rollback, hang watchdog — and, drills only, the
         # deterministic fault injector (inactive plan = zero overhead).
-        injector = resilience.FaultInjector(resilience.FaultPlan.from_config(rcfg))
+        injector = resilience.FaultInjector(
+            resilience.FaultPlan.from_config(rcfg),
+            train_dir=cfg.train.train_dir)
+        if injector.plan.preempt_burst > 0:
+            # Cumulative across supervised restarts (state file in the
+            # train_dir) — a resumed child reports the burst so far.
+            telemetry.set("fault_preempt_burst",
+                          float(injector.burst_fired))
         shutdown = resilience.ShutdownCoordinator(
             enabled=rcfg.graceful_shutdown).install()
         sentinel = resilience.NaNSentinel(rcfg.nan_max_retries,
@@ -224,17 +243,54 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             telemetry=telemetry, spans=spans)
 
         injector.maybe_corrupt_checkpoint(cfg.train.train_dir)
-        ckpt = CheckpointManager(cfg.train.train_dir,
-                                 keep=cfg.train.keep_checkpoints, spans=spans)
+        ckpt = CheckpointManager(
+            cfg.train.train_dir, keep=cfg.train.keep_checkpoints,
+            spans=spans,
+            topology=(elastic_ctx.current if elastic_ctx is not None
+                      else resilience.elastic.topology_record(
+                          mesh, partitioner.mode,
+                          cfg.train.global_batch_size)))
+        # topology.json must name the topology that wrote the NEWEST
+        # checkpoints, so it is written on this run's FIRST successful
+        # save (all three save sites call this), never at startup — a
+        # reshaped resume that dies before saving leaves the record on
+        # the old topology, keeping the next resume's reshape detection
+        # and restore-error hints truthful. The wait() pins that to the
+        # save's COMMIT, not its async enqueue (a SIGKILL between
+        # enqueue and commit must not leave a record without its
+        # checkpoint); once per run, so the sync cost never recurs.
+        topology_recorded = False
+
+        def record_topology():
+            nonlocal topology_recorded
+            if not topology_recorded:
+                topology_recorded = True
+                ckpt.wait()
+                resilience.elastic.write_topology(
+                    cfg.train.train_dir, mesh, partitioner.mode,
+                    cfg.train.global_batch_size)
+
         latest = ckpt.latest_step()
         if latest is not None:
             # restore() falls back through all_steps() past corrupt/torn
             # checkpoints to the newest restorable one; as the directory's
             # owner, the trainer also discards the steps that failed (the run
             # will re-reach those step numbers and must be able to save them).
+            # The template is the CURRENT topology's partitioned state, so a
+            # checkpoint written on a different mesh/partition restores
+            # through an explicit cross-topology reshard (orbax stores
+            # global logical arrays) — value-identical, never corrupted.
             state = ckpt.restore(state, discard_failed=True)
             log.info("resumed from step %d in %s",
                      int(jax.device_get(state.step)), cfg.train.train_dir)
+        if elastic_ctx is not None and elastic_ctx.changed:
+            # The reshape as a first-class event: a span on the run
+            # timeline (trace-export renders capacity waves), a gauge,
+            # and — written above — a manifest entry.
+            spans.event("topology_change",
+                        step=int(jax.device_get(state.step)),
+                        **elastic_ctx.attrs())
+            telemetry.set("topology_changes", 1.0)
 
         if metrics is None:
             metrics = MetricsWriter(cfg.train.train_dir,
@@ -622,6 +678,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                 elif ckpt.save(step, state):
                     last_ckpt_step = step
                     telemetry.set("checkpoint_lag_steps", 0)
+                    record_topology()
         if shutdown.requested and step < total:
             # Preemption honored at the chunk boundary: force a final save
             # so the resume loses zero steps, then mark the event. The
@@ -631,8 +688,12 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                         "checkpoint before exit", step)
             spans.event("preempt_stop", step=step, signum=shutdown.signum)
             telemetry.set("fault_preemptions", 1.0)
+            if injector.plan.preempt_burst > 0:
+                telemetry.set("fault_preempt_burst",
+                              float(injector.burst_fired))
             if step > last_ckpt_step and ckpt.save(step, state, force=True):
                 last_ckpt_step = step
+                record_topology()
     finally:
         # One shutdown path for clean exits AND exceptions. Each closer
         # runs even if an earlier one raises (a failed ckpt.wait must not
@@ -678,6 +739,7 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             def _emergency_save():
                 if ckpt.save(step, state, force=True):
                     spans.event("emergency_save", step=step)
+                    record_topology()
                     log.warning("emergency checkpoint saved at step %d "
                                 "after in-flight %s", step,
                                 exc_type.__name__)
