@@ -1,0 +1,38 @@
+"""Seeded host-isolation + signal-safety violations for the fleet
+router. The real serve/router.py is stdlib-only (it must come up on a
+host whose accelerator stack is broken) and delegates SIGTERM to the
+flag-only ShutdownCoordinator; this fixture is the pair of anti-patterns
+that must stay flagged: a module-scope jax import, and a handler that
+tears the fleet down inline instead of setting a flag for route()."""
+
+import signal
+import time
+
+import jax  # host-isolation: the router must never import jax
+
+
+class EagerTeardownRouter:
+    """'Just drain the fleet right here in the handler' — every call
+    below runs at an arbitrary bytecode boundary of the interrupted
+    prober/forwarder threads."""
+
+    def __init__(self, httpd, prober, replicas):
+        self._httpd = httpd
+        self._prober = prober
+        self._replicas = replicas
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._handle)
+
+    def _handle(self, signum, frame):
+        self._teardown_now(signum)  # transitively unsafe
+
+    def _teardown_now(self, signum):
+        for replica in self._replicas:
+            self.drain_replica(replica.name)  # flagged: joins + signals
+        time.sleep(0.5)                       # flagged: sleep in handler
+        self._httpd.shutdown()                # flagged: socket teardown
+        self._prober.join()                   # flagged: thread join
+
+    def drain_replica(self, name):
+        return jax.device_count(), name

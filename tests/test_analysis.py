@@ -529,3 +529,21 @@ def test_doctor_check_section():
     out = doctor._check_static_analysis(matrix=False)
     assert out["ok"] is True, out
     assert out["errors"] == 0 and out["stale_baseline"] == 0
+
+
+def test_route_fixture_flags_jax_import_and_handler_teardown():
+    """The fleet-router anti-patterns stay flagged: a module-scope jax
+    import in the host-isolated router (it must come up on a host whose
+    accelerator stack is broken), and a SIGTERM handler that tears the
+    fleet down inline instead of setting a flag for route()."""
+    found = fixture_findings("route_bad")
+    host = [f for f in found if f.rule == "host-isolation"]
+    assert len(host) == 1
+    assert "import of 'jax'" in host[0].message
+    assert host[0].path == "tpu_resnet/serve/router.py"
+    sig = "\n".join(f.message for f in found
+                    if f.rule == "signal-safety")
+    for hazard in ("self._httpd.shutdown", "self._prober.join",
+                   "time.sleep", "self.drain_replica"):
+        assert hazard in sig, f"{hazard} not flagged:\n{sig}"
+    assert "_handle -> _teardown_now" in sig

@@ -376,3 +376,77 @@ def test_resilience_config_overrides_and_serialization():
 
     round_tripped = RunConfig.from_dict(cfg.to_dict())
     assert round_tripped.resilience == cfg.resilience
+
+
+# --------------------------------------------- serve-side faults (fleet)
+
+def test_serve_fault_plan_env_and_config():
+    rcfg = load_config("smoke").resilience
+    env = {"TPU_RESNET_FAULT_SERVE_SLOW_MS": "25",
+           "TPU_RESNET_FAULT_SERVE_HANG_REQ": "4",
+           "TPU_RESNET_FAULT_SERVE_KILL_REQ": "9"}
+    plan = faultinject.FaultPlan.from_config(rcfg, env=env)
+    assert plan.serve_slow_ms == 25.0
+    assert plan.serve_hang_at_request == 4
+    assert plan.serve_kill_at_request == 9
+    assert plan.serves_faults and plan.active
+    rcfg.inject_serve_slow_ms = 10.0
+    plan = faultinject.FaultPlan.from_config(rcfg, env={})
+    assert plan.serve_slow_ms == 10.0 and plan.active
+
+
+def test_serve_fault_wrap_is_identity_when_off():
+    inj = resilience.FaultInjector(faultinject.FaultPlan())
+
+    def infer(x):
+        return x
+
+    assert inj.wrap_serve_infer(infer) is infer  # zero overhead when off
+
+
+def test_serve_fault_slow_injects_latency():
+    import time as _time
+
+    inj = resilience.FaultInjector(
+        faultinject.FaultPlan(serve_slow_ms=60.0))
+    wrapped = inj.wrap_serve_infer(lambda x: x * 2)
+    t0 = _time.monotonic()
+    assert wrapped(21) == 42
+    assert _time.monotonic() - t0 >= 0.05
+
+
+def test_serve_fault_kill_fires_at_request_k(monkeypatch):
+    kills = []
+    monkeypatch.setattr(faultinject.os, "kill",
+                        lambda pid, sig: kills.append((pid, sig)))
+    inj = resilience.FaultInjector(
+        faultinject.FaultPlan(serve_kill_at_request=3))
+    inj.note_serve_request()
+    inj.note_serve_request()
+    assert kills == []          # requests 1-2 sail through
+    inj.note_serve_request()
+    import signal as _signal
+
+    assert kills == [(faultinject.os.getpid(), _signal.SIGKILL)]
+
+
+def test_serve_fault_hang_pins_the_infer_thread(monkeypatch):
+    """accept-then-hang: the wrapped infer loops in sleep forever (the
+    batcher thread is the one that hangs). The test breaks the loop by
+    making the injected sleep raise."""
+
+    class _Escape(Exception):
+        pass
+
+    def boom(sec):
+        raise _Escape(f"slept {sec}")
+
+    monkeypatch.setattr(faultinject.time, "sleep", boom)
+    inj = resilience.FaultInjector(
+        faultinject.FaultPlan(serve_hang_at_request=2))
+    wrapped = inj.wrap_serve_infer(lambda x: x)
+    inj.note_serve_request()
+    assert wrapped(1) == 1      # request 1: before the hang point
+    inj.note_serve_request()
+    with pytest.raises(_Escape):
+        wrapped(2)              # request 2: hung (sleep loop entered)

@@ -252,7 +252,7 @@ def test_drain_flushes_straggler_that_raced_admission():
     b = _mk(_echo_infer()).start()
     assert b.drain(5.0) is True          # worker exited, queue empty
     straggler = PendingRequest(_images(1))
-    b._queue.put_nowait(straggler)       # the raced-admission analog
+    b._queue.put_nowait((0, 1, straggler))  # the raced-admission analog
     b.drain(0.1)
     with pytest.raises(Draining):
         straggler.wait(1.0)
@@ -782,3 +782,184 @@ def test_serve_spans_written_with_run_id(tmp_path):
     assert all(r["run_id"] == rid for r in recs)
     drain = next(r for r in recs if r["span"] == "serve_drain")
     assert drain["clean"] is True
+
+
+# ------------------------------------------- fleet satellites (ISSUE 13)
+
+def test_429_carries_retry_after_and_queue_depth_in_info():
+    """Backpressure responses carry Retry-After (the router/client
+    backoff hint) and /info exposes queue_depth top-level so the
+    router's passive signal is one scrape."""
+    entered, release = threading.Event(), threading.Event()
+
+    class StuckBackend(FakeBackend):
+        def infer(self, images):
+            entered.set()
+            release.wait(10.0)
+            return super().infer(images)
+
+    backend = StuckBackend()
+    srv = PredictServer(_serve_cfg(max_queue=1, max_wait_ms=1.0),
+                        backend=backend).start()
+    try:
+        first = srv.batcher.submit(_images(1))
+        assert entered.wait(5.0)            # worker pinned mid-batch
+        srv.batcher.submit(_images(1))      # queue now full (1 slot)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict",
+            data=_images(1).tobytes(),
+            headers={"Content-Type": "application/octet-stream",
+                     "X-Shape": "1,8,8,3"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 429
+        assert exc.value.headers.get("Retry-After") is not None
+        payload = json.loads(exc.value.read())
+        assert payload["retryable"] and "retry_after_secs" in payload
+
+        code, body = _get(srv.port, "/info")
+        info = json.loads(body)
+        assert info["queue_depth"] >= 1           # top-level, one scrape
+        assert info["queue_depth"] == info["stats"]["queue_depth"]
+        assert info["replica_name"] == ""
+    finally:
+        release.set()
+        first.wait(5.0)
+        srv.batcher.drain(5.0)
+        srv.close()
+
+
+def test_x_lane_header_routes_to_batch_lane(fake_server):
+    srv, backend = fake_server
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/predict",
+        data=_images(1, 2).tobytes(),
+        headers={"Content-Type": "application/octet-stream",
+                 "X-Shape": "1,8,8,3", "X-Lane": "batch"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    # unknown lanes degrade to interactive (strict lane), not a 500
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/predict",
+        data=_images(1, 2).tobytes(),
+        headers={"Content-Type": "application/octet-stream",
+                 "X-Shape": "1,8,8,3", "X-Lane": "bulk"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 200
+    stats = srv.batcher.stats()
+    assert stats["lane_batch"] == 1 and stats["lane_interactive"] >= 1
+
+
+def test_named_discovery_for_fleets(tmp_path):
+    from tpu_resnet.serve.server import read_serve_port, write_discovery
+
+    write_discovery(str(tmp_path), 8001, name="r0")
+    write_discovery(str(tmp_path), 8002, name="r1")
+    write_discovery(str(tmp_path), 8003)
+    assert (tmp_path / "serve-r0.json").exists()
+    assert (tmp_path / "serve-r1.json").exists()
+    # the bare serve.json single-replica contract is untouched
+    assert read_serve_port(str(tmp_path)) == 8003
+    with open(tmp_path / "serve-r0.json") as f:
+        assert json.load(f)["name"] == "r0"
+
+
+def test_drain_during_reload_finishes_swap_before_teardown():
+    """The drain-during-reload lock contract at the server level: a
+    drain landing while maybe_reload() is mid-swap waits for the swap to
+    complete (the batcher finishes its between-batches hook before
+    exiting); the model is never observable half-swapped."""
+    reload_started, finish_reload = threading.Event(), threading.Event()
+
+    class SlowReloadBackend(FakeBackend):
+        def maybe_reload(self):
+            if self.reload_armed:
+                self.reload_armed = False
+                reload_started.set()
+                finish_reload.wait(10.0)   # mid-swap window
+                self.model_step += 1
+                self.reloads += 1
+                return True
+            return False
+
+    backend = SlowReloadBackend()
+    srv = PredictServer(_serve_cfg(), backend=backend).start()
+    assert _post(srv.port, _images(1, 3).tobytes(),
+                 shape="1,8,8,3")[0] == 200
+    backend.reload_armed = True
+    assert reload_started.wait(5.0)        # batcher is inside the swap
+    drained = []
+    t = threading.Thread(target=lambda: drained.append(srv.drain(10.0)))
+    t.start()
+    time.sleep(0.2)
+    assert not drained                     # drain is waiting on the swap
+    assert backend.model_step == 7         # never half-swapped
+    finish_reload.set()
+    t.join(10.0)
+    assert drained == [True]
+    assert backend.model_step == 8 and backend.reloads == 1
+    srv.close()
+
+
+def test_checkpoint_backend_close_blocks_until_swap_completes(
+        monkeypatch):
+    """The backend-level lock ordering (serve/backend.py): close() must
+    wait out an in-flight restore+swap, and a swap that loses the race
+    aborts cleanly instead of touching a closed manager."""
+    from tpu_resnet.serve.backend import CheckpointBackend
+
+    restore_entered, release_restore = threading.Event(), threading.Event()
+
+    class FakeState:
+        params = {"w": 1}
+        batch_stats = {"m": 2}
+
+    def slow_restore(ckpt, template, step, retries, backoff_sec):
+        restore_entered.set()
+        release_restore.wait(10.0)
+        return FakeState()
+
+    monkeypatch.setattr("tpu_resnet.train.checkpoint.restore_with_retry",
+                        slow_restore)
+
+    class FakeCkpt:
+        closed = False
+
+        def close(self):
+            # the lock contract: never closed while a swap is mid-flight
+            assert restore_entered.is_set() and release_restore.is_set()
+            self.closed = True
+
+    class FakePoller:
+        seen = []
+
+        def mark_seen(self, step):
+            self.seen.append(step)
+
+    b = CheckpointBackend.__new__(CheckpointBackend)
+    b._cfg = load_config("smoke")
+    b._ckpt = FakeCkpt()
+    b._poller = FakePoller()
+    b._template = object()   # opaque: the mocked restore ignores it
+    b._swap_lock = threading.Lock()
+    b._closed = False
+    b._variables = None
+    b.model_step = -1
+
+    results = []
+    loader = threading.Thread(target=lambda: results.append(b._load(5)))
+    loader.start()
+    assert restore_entered.wait(5.0)       # swap is mid-restore
+    closer = threading.Thread(target=b.close)
+    closer.start()
+    time.sleep(0.2)
+    assert not b._ckpt.closed              # close() is blocked on the lock
+    release_restore.set()
+    loader.join(5.0)
+    closer.join(5.0)
+    assert results == [True]
+    assert b.model_step == 5               # swap completed before close
+    assert b._variables == {"params": {"w": 1}, "batch_stats": {"m": 2}}
+    assert b._ckpt.closed
+    # post-close reload attempts abort cleanly (no manager access)
+    assert b._load(6) is False

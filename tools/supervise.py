@@ -27,10 +27,27 @@ command, and the trainer's elastic resume reshards the checkpoint onto
 the smaller mesh. Later CLI overrides win in the config system, so the
 appended override takes effect without editing the base command.
 
+**Fleet mode** (serving): ``--fleet N`` supervises N children of one
+command template from a single invocation — ``{i}`` in the args becomes
+the child index, so ``serve.replica_name=r{i}`` names each replica's
+discovery file. Every child keeps its own independent decorrelated-
+jitter backoff (a fleet sharing a fault must not stampede back in
+lockstep); ``--stop-codes 3`` honors the serve colocation-admission
+verdict (exit 3 = "no capacity on this host" — restarting here is
+pointless; let the placement layer pick another host); and
+``--restart-clean-exits`` gives exit 0 fleet semantics — a replica that
+exits 0 was *drained* (``route --drain``, rolling upgrade) and must come
+back so the router readmits it, unlike a trainer whose 0 means "done".
+
 Usage:
 
     python tools/supervise.py [options] -- python -m tpu_resnet train \
         --preset cifar10 train.train_dir=/data/run1
+
+    python tools/supervise.py --fleet 2 --stop-codes 3 \
+        --restart-clean-exits -- \
+        python -m tpu_resnet serve --preset cifar10 \
+        train.train_dir=/data/run1 serve.replica_name=r{i}
 
 Stdlib-only and jax-free: it must keep working on a host whose accelerator
 stack is the thing that is crashing.
@@ -113,14 +130,26 @@ def supervise(cmd, max_restarts: int = 100, preempt_code: int =
               backoff_cap: float = 300.0, preempt_delay: float = 1.0,
               jitter: bool = True, rng=None,
               downsize_after: int = 0, downsize_window: float = 600.0,
-              mesh_ladder=(), run=None, sleep=time.sleep) -> int:
+              mesh_ladder=(), stop_codes=(), restart_clean: bool = False,
+              run=None, sleep=time.sleep) -> int:
     """Run ``cmd`` under the restart policy; returns the final exit code.
     ``run``/``sleep``/``rng`` are injectable for tests; ``jitter=False``
-    restores the deterministic base·2^crashes schedule."""
+    restores the deterministic base·2^crashes schedule. ``stop_codes``
+    are exit codes that END supervision immediately (no restart) while
+    still reporting the code — the serve fleet uses 3 here, the
+    colocation-admission "placed elsewhere" verdict (resilience/
+    elastic.py): restarting on the same host would just be denied
+    again. ``restart_clean=True`` restarts exit-0 children too (after
+    ``preempt_delay``, no crash backoff): serving-fleet semantics, where
+    a replica's clean exit means it was DRAINED for a rolling
+    hot-reload/upgrade (``route --drain``) and the upgrade contract is
+    that it comes back and the router readmits it — without this the
+    documented rolling drain would permanently shrink the fleet."""
     if run is None:
         run = lambda c: subprocess.call(c)  # noqa: E731
     if rng is None:
         rng = random.Random()
+    stop_codes = set(stop_codes)
     policy = (DownsizePolicy(downsize_after, downsize_window, mesh_ladder)
               if downsize_after > 0 and mesh_ladder else None)
     mesh_override = None  # appended last: later config overrides win
@@ -132,15 +161,30 @@ def supervise(cmd, max_restarts: int = 100, preempt_code: int =
         run_id = _run_id_of(cmd)
         if run_id:
             log.info("supervised run_id=%s exited %d", run_id, rc)
-        if rc == 0:
+        if rc == 0 and not restart_clean:
             log.info("command exited 0 after %d restart(s)", restarts)
             return 0
+        if rc in stop_codes:
+            log.warning("exit code %d is a stop code (e.g. colocation "
+                        "admission denied) — not restarting", rc)
+            return rc
         if restarts >= max_restarts:
             log.error("giving up after %d restart(s); last exit code %d",
                       restarts, rc)
             return rc
         restarts += 1
-        if rc == preempt_code:
+        if rc == 0:
+            # Clean exit under restart_clean = a drained serve replica
+            # in a rolling upgrade: bring it straight back (preempt-
+            # style fixed delay, no crash backoff) so the router's
+            # probe readmits it and the fleet regains capacity.
+            crash_streak = 0
+            prev_delay = backoff_base
+            delay = preempt_delay
+            log.info("clean exit (drained) — restarting in %.1fs for "
+                     "the rolling-upgrade readmit (restart %d/%d)",
+                     delay, restarts, max_restarts)
+        elif rc == preempt_code:
             crash_streak = 0
             prev_delay = backoff_base
             delay = preempt_delay
@@ -176,6 +220,46 @@ def supervise(cmd, max_restarts: int = 100, preempt_code: int =
         sleep(delay)
 
 
+def supervise_fleet(cmd, fleet: int, placeholder: str = "{i}",
+                    **kwargs) -> int:
+    """Fleet mode: supervise ``fleet`` children of ``cmd`` from ONE
+    invocation, each under its own independent restart policy (the
+    decorrelated-jitter crash backoff per child is exactly what keeps a
+    fleet that shares a fault from restarting in stampede lockstep).
+
+    ``placeholder`` occurrences in the command args are substituted with
+    the child index, so one template names per-replica identities:
+
+        supervise.py --fleet 3 -- python -m tpu_resnet serve \\
+            train.train_dir=/data/run1 serve.replica_name=r{i}
+
+    Returns 0 when every child ends 0, else the first nonzero child
+    code. Stdlib-only, one thread per child (the children are processes;
+    the threads just run their restart loops)."""
+    import threading
+
+    rcs = [None] * fleet
+    threads = []
+    for i in range(fleet):
+        child_cmd = [a.replace(placeholder, str(i))
+                     if isinstance(a, str) else a for a in cmd]
+
+        def runner(idx=i, c=child_cmd):
+            log.info("fleet child %d: %s", idx, " ".join(map(str, c)))
+            rcs[idx] = supervise(c, **kwargs)
+            log.info("fleet child %d finished rc=%s", idx, rcs[idx])
+
+        t = threading.Thread(target=runner, name=f"supervise-fleet-{i}",
+                             daemon=True)
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    bad = [rc for rc in rcs if rc not in (0, None)]
+    log.info("fleet done: rcs=%s", rcs)
+    return bad[0] if bad else 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
@@ -206,6 +290,22 @@ def main(argv=None) -> int:
     p.add_argument("--mesh-ladder", default="",
                    help="comma-separated mesh.data sizes to step down "
                         "through on downsize, largest first (e.g. 4,2)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="fleet mode: supervise N children of the same "
+                        "command template, '{i}' in args replaced by "
+                        "the child index (serve.replica_name=r{i}); "
+                        "each child keeps its own restart policy")
+    p.add_argument("--stop-codes", default="",
+                   help="comma-separated exit codes that stop "
+                        "supervision without a restart (e.g. 3 = serve "
+                        "colocation admission denied: this host has no "
+                        "capacity, restarting here is pointless)")
+    p.add_argument("--restart-clean-exits", action="store_true",
+                   help="restart exit-0 children too (serving fleets: a "
+                        "replica's clean exit means it was DRAINED for "
+                        "a rolling upgrade and must come back for the "
+                        "router to readmit; trainers keep the default "
+                        "'0 = done')")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="command to supervise (prefix with --)")
     args = p.parse_args(argv)
@@ -218,17 +318,27 @@ def main(argv=None) -> int:
     except ValueError:
         p.error(f"--mesh-ladder must be comma-separated integers "
                 f"(e.g. 4,2): {args.mesh_ladder!r}")
+    try:
+        stop_codes = tuple(int(x) for x in args.stop_codes.split(",")
+                           if x.strip())
+    except ValueError:
+        p.error(f"--stop-codes must be comma-separated integers: "
+                f"{args.stop_codes!r}")
     if args.downsize_after > 0 and not ladder:
         p.error("--downsize-after requires --mesh-ladder")
-    return supervise(cmd, max_restarts=args.max_restarts,
-                     preempt_code=args.preempt_code,
-                     backoff_base=args.backoff_base,
-                     backoff_cap=args.backoff_cap,
-                     preempt_delay=args.preempt_delay,
-                     jitter=not args.no_jitter,
-                     downsize_after=args.downsize_after,
-                     downsize_window=args.downsize_window,
-                     mesh_ladder=ladder)
+    kwargs = dict(max_restarts=args.max_restarts,
+                  preempt_code=args.preempt_code,
+                  backoff_base=args.backoff_base,
+                  backoff_cap=args.backoff_cap,
+                  preempt_delay=args.preempt_delay,
+                  jitter=not args.no_jitter,
+                  downsize_after=args.downsize_after,
+                  downsize_window=args.downsize_window,
+                  mesh_ladder=ladder, stop_codes=stop_codes,
+                  restart_clean=args.restart_clean_exits)
+    if args.fleet > 0:
+        return supervise_fleet(cmd, args.fleet, **kwargs)
+    return supervise(cmd, **kwargs)
 
 
 if __name__ == "__main__":

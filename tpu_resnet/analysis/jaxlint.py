@@ -32,6 +32,15 @@ signal-safety     Handlers registered via ``signal.signal`` may only set
                   run at an arbitrary bytecode boundary of the
                   interrupted main thread (mid-save, mid-dispatch) and
                   deadlock or corrupt state.
+host-isolation    The serving fleet's host-side control plane
+                  (``serve/router.py``, ``serve/batcher.py``) must stay
+                  importable with NO accelerator stack: the router keeps
+                  answering when the accelerator runtime is the thing
+                  that is broken, and stdlib-only consumers (loadgen,
+                  the doctor probes, supervise) import these modules on
+                  machines with no backend. A module-scope jax/flax/tf
+                  import there breaks that contract silently — the same
+                  class of rot fork-safety pins for the decode workers.
 guard-parity      Fail-loud guard parity (ADVICE r4): the validation in
                   ``models.build_model`` must also exist in the public
                   constructors (``cifar_resnet_v2``/``imagenet_resnet_v2``)
@@ -80,6 +89,16 @@ FORK_ENTRY_FILES = ("tpu_resnet/data/engine.py",)
 FORK_FORBIDDEN_ROOTS = {"jax", "jaxlib", "flax", "optax", "orbax",
                         "tensorflow", "torch"}
 
+# Host-isolated serving control plane: these modules must import with no
+# accelerator stack present (router on a broken-runtime host; batcher in
+# stdlib-only consumers). Direct module-scope imports only — unlike
+# fork-safety there is no transitive closure walk, because the contract
+# is per-module and the modules' own imports (server.py etc.) are the
+# jax-aware layer by design.
+HOST_ONLY_FILES = ("tpu_resnet/serve/router.py",
+                   "tpu_resnet/serve/batcher.py",
+                   "tpu_resnet/serve/discovery.py")
+
 HOST_SYNC_EXACT = {
     "print": "host I/O",
     "jax.device_get": "device→host transfer",
@@ -118,13 +137,18 @@ HOST_SYNC_METHODS = {
 
 SIGNAL_DENY_PREFIXES = ("subprocess.", "jax.", "jax_", "numpy.",
                         "shutil.", "socket.", "os.system", "os.popen")
-SIGNAL_DENY_EXACT = {"open", "time.sleep", "exec", "eval"}
+# os.kill: the ROUTER SIGTERM anti-pattern — cascading the drain signal
+# to the replica fleet inline in the handler (the route() loop owns
+# teardown; handlers only set the flag).
+SIGNAL_DENY_EXACT = {"open", "time.sleep", "exec", "eval", "os.kill"}
 # "drain"/"shutdown": the serve SIGTERM anti-pattern — draining the
 # micro-batcher or tearing down the HTTP socket inline in the handler
-# instead of setting a flag for the serve() loop (serve/server.py).
+# instead of setting a flag for the serve()/route() loop
+# (serve/server.py, serve/router.py). "drain_replica": the router's
+# rolling-drain method, which joins threads and signals processes.
 SIGNAL_DENY_METHODS = {"save", "restore", "acquire", "join", "wait",
                        "sleep", "write", "flush", "dump", "drain",
-                       "shutdown"}
+                       "shutdown", "drain_replica"}
 SIGNAL_LOG_ROOTS = {"log", "logger", "logging"}
 
 # (file, qualname, requirement) — requirement is "calls:<fn>" (body must
@@ -679,6 +703,34 @@ def rule_signal_safety(tree: SourceTree) -> List[Finding]:
     return findings
 
 
+def rule_host_isolation(tree: SourceTree) -> List[Finding]:
+    """serving control-plane modules stay jax-free at module scope."""
+    findings = []
+    for rel in HOST_ONLY_FILES:
+        if not tree.has(rel):
+            continue
+        mod = tree.trees[rel]
+        for node in _module_scope_nodes(mod, (ast.Import, ast.ImportFrom)):
+            if isinstance(node, ast.Import):
+                modules = [(a.name, node.lineno) for a in node.names]
+            else:
+                if node.level:  # relative: stays inside tpu_resnet
+                    continue
+                modules = [(node.module or "", node.lineno)]
+            for module, lineno in modules:
+                if module.split(".")[0] in FORK_FORBIDDEN_ROOTS:
+                    findings.append(Finding(
+                        "host-isolation", rel, lineno,
+                        f"module-scope import of '{module}' in a "
+                        f"host-isolated serving module: the router/"
+                        f"batcher must come up on a machine whose "
+                        f"accelerator stack is broken, and stdlib-only "
+                        f"consumers (loadgen, doctor, supervise) import "
+                        f"this module backend-free — import it lazily "
+                        f"inside the function that needs it"))
+    return findings
+
+
 def rule_guard_parity(tree: SourceTree) -> List[Finding]:
     """build_model validation mirrored into public constructors (ADVICE r4)."""
     findings = []
@@ -740,6 +792,7 @@ RULES = {
     "jit-static-args": rule_jit_static_args,
     "fork-safety": rule_fork_safety,
     "signal-safety": rule_signal_safety,
+    "host-isolation": rule_host_isolation,
     "guard-parity": rule_guard_parity,
 }
 

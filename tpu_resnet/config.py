@@ -381,6 +381,20 @@ class ResilienceConfig:
     # deterministic drill for tools/supervise.py's downsize policy.
     inject_preempt_burst: int = 0
     inject_preempt_burst_every: int = 10
+    # ---- serve-side faults (fleet chaos drills; docs/RESILIENCE.md) ----
+    # Applied by the predict server (serve/server.py wraps the backend
+    # infer / request admission). Env overrides: TPU_RESNET_FAULT_
+    # {SERVE_SLOW_MS, SERVE_HANG_REQ, SERVE_KILL_REQ}.
+    # Fixed extra latency per inference batch (slow-replica injection —
+    # the router's passive latency tracking and hedging drill).
+    inject_serve_slow_ms: float = 0.0
+    # Accept requests normally, then hang the inference worker forever
+    # starting at the Nth predict request (-1 off): the accept-then-hang
+    # replica the router must evict on probe/deadline, not crash on.
+    inject_serve_hang_at_request: int = -1
+    # SIGKILL this serve process at the Nth predict request (-1 off):
+    # the hard replica death mid-traffic the failover drill rides.
+    inject_serve_kill_at_request: int = -1
 
 
 @dataclasses.dataclass
@@ -428,6 +442,13 @@ class ServeConfig:
     # Latency ring: recent per-request latencies kept for the p50/p95/p99
     # gauges on /metrics.
     latency_ring: int = 1024
+    # /healthz staleness for the SERVING heartbeat (the batcher loop
+    # ticks it every batch and every idle tick, so any gap of seconds
+    # means the inference worker is wedged). Much tighter than the
+    # trainer's train.telemetry_stale_sec (300 s — sized for long
+    # compiles): a hung replica must flip 503 fast enough that the
+    # router's half-open probe cannot flap it back into rotation.
+    healthz_stale_sec: float = 10.0
     # Colocation admission (resilience/elastic.py): estimated HBM bytes
     # this replica needs (weights + bucket activations). >0 gates startup
     # on the live device-memory gauges — a replica joining a trainer's
@@ -435,6 +456,68 @@ class ServeConfig:
     # when denied, so a scheduler can tell "no capacity here" from a
     # crash). 0 = no arbitration (single-tenant hosts).
     admission_hbm_bytes: int = 0
+    # Fleet identity: when nonempty the discovery file is written as
+    # <train_dir>/serve-<name>.json instead of serve.json, so N replicas
+    # sharing one train_dir (same checkpoints, hot-reload in lockstep)
+    # each announce their own port/pid and the router (serve/router.py)
+    # discovers the whole fleet from one directory scan.
+    replica_name: str = ""
+
+
+@dataclasses.dataclass
+class RouteConfig:
+    """Multi-replica serving router (tpu_resnet/serve/router.py;
+    docs/SERVING.md "Serving fleet"). A stdlib-HTTP front that spreads
+    /predict traffic over N serve replicas with active health probing,
+    per-replica circuit breakers, bounded failover retries under a
+    per-request deadline budget, optional hedged sends, and SLO-aware
+    lane shedding — the production shape one replica process never had."""
+
+    # Router HTTP port: 0 = OS-assigned ephemeral (recorded in
+    # <discover_dir>/route.json), >0 fixed.
+    port: int = 0
+    host: str = "0.0.0.0"
+    # Static replica list: base URLs ("http://127.0.0.1:8500", ...).
+    # Named r0..rN-1 in rotation order. Empty = discovery only.
+    replicas: tuple = ()
+    # Discovery directory: scanned every probe round for the replicas'
+    # serve.json / serve-<name>.json announcements (serve.replica_name).
+    # A replica that restarts on a new port is re-resolved within one
+    # probe interval. Also where route.json and route_events.jsonl land.
+    discover_dir: str = ""
+    # Active health: /healthz (+ /info queue depth) probed per replica
+    # every probe_interval_secs with probe_timeout_secs. A killed or
+    # hung replica is out of rotation within one probe interval.
+    probe_interval_secs: float = 1.0
+    probe_timeout_secs: float = 2.0
+    # Circuit breaker: fail_threshold consecutive failures (probe or
+    # passive request failures) open the circuit; after open_secs the
+    # breaker goes half-open and the next successful probe readmits.
+    fail_threshold: int = 2
+    open_secs: float = 5.0
+    # Per-request deadline budget (ms): the failover retry only fires
+    # when enough budget remains, so a retry never blows the client SLO.
+    # Clients can tighten per request with an X-Deadline-Ms header.
+    deadline_ms: float = 10_000.0
+    # Hedged sends: 0 = off (default). >0 = duplicate a request to a
+    # second healthy replica after this many ms without a response;
+    # -1 = auto (hedge at the router's rolling p99, floor 10 ms). First
+    # response wins; gauged as route_hedges_total / route_hedge_wins.
+    hedge_ms: float = 0.0
+    # SLO-aware admission: 0 = shedding off. >0 = when the router's own
+    # rolling p99 over the recent ring exceeds slo_ms, batch-lane
+    # requests (X-Lane: batch) are shed with 429 + Retry-After; past
+    # slo_ms * shed_hard_factor the interactive lane sheds too — never
+    # queue-collapse, always an explicit retryable rejection.
+    slo_ms: float = 0.0
+    shed_hard_factor: float = 2.0
+    # Recent end-to-end latencies kept for the rolling p50/p99 (the shed
+    # and hedge signals, and the route_p99_ms gauge).
+    latency_ring: int = 2048
+    # Admin drain (route --drain NAME / POST /admin/drain): seconds to
+    # wait for the drained replica's in-flight requests, then SIGTERM
+    # (pid from its discovery record) and wait for the PR 2/5 drain.
+    drain_timeout_secs: float = 30.0
 
 
 @dataclasses.dataclass
@@ -447,6 +530,7 @@ class RunConfig:
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
+    route: RouteConfig = dataclasses.field(default_factory=RouteConfig)
 
     # ---------------------------------------------------------- serialization
     def to_dict(self) -> dict:

@@ -11,6 +11,7 @@ L4) with one command:
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import sys
 
@@ -49,6 +50,9 @@ def main(argv=None):
         ("predict", "run a frozen artifact over the eval split"),
         ("serve", "online inference: dynamic-batching HTTP predict server "
                   "with checkpoint hot-reload (docs/SERVING.md)"),
+        ("route", "serving-fleet front router: spread /predict over N "
+                  "serve replicas with health-probed failover, SLO-aware "
+                  "load shedding and rolling drains (docs/SERVING.md)"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("trace-export", "merge a run's spans/metrics/eval/serve events "
@@ -69,6 +73,17 @@ def main(argv=None):
         if name == "eval":
             p.add_argument("--once", action="store_true",
                            help="evaluate latest checkpoint once and exit")
+        if name == "route":
+            p.add_argument("--drain", default="",
+                           help="rolling operations: ask a RUNNING "
+                                "router to drain replica NAME (exclude "
+                                "from rotation, wait out in-flight, "
+                                "SIGTERM per the drain contract) and "
+                                "exit — instead of starting a router")
+            p.add_argument("--router-url", default="",
+                           help="with --drain: the running router's "
+                                "base url (default: discovered from "
+                                "route.json in route.discover_dir)")
         if name == "info":
             p.add_argument("--layers", action="store_true",
                            help="per-parameter table (tfprof-style dump)")
@@ -122,6 +137,16 @@ def main(argv=None):
                                 "an ephemeral port, fire requests, check "
                                 "/healthz readiness and the SIGTERM "
                                 "drain exit-code contract")
+            p.add_argument("--fleet-probe", action="store_true",
+                           help="serving-fleet resilience drill (~2min "
+                                "scrubbed CPU): 2 serve replicas + the "
+                                "front router on ephemeral ports, "
+                                "SIGKILL one replica mid-traffic -> "
+                                "zero failed requests, circuit opens "
+                                "within a probe interval, hot-reload on "
+                                "the survivor, rolling admin drain, "
+                                "exit-code contract, trace-export "
+                                "router+replica lanes")
             p.add_argument("--data-bench", action="store_true",
                            help="~20s synthetic-JPEG decode throughput "
                                 "probe: images/sec at 1 vs N decode "
@@ -186,6 +211,7 @@ def main(argv=None):
                              data_bench=args.data_bench,
                              check=args.check,
                              serve_probe=args.serve_probe,
+                             fleet_probe=args.fleet_probe,
                              trace_probe=args.trace_probe,
                              perfwatch=args.perfwatch,
                              sweep_probe=args.sweep_probe,
@@ -259,6 +285,27 @@ def main(argv=None):
         from tpu_resnet.serve import serve as serve_fn
         parallel.initialize()
         return serve_fn(cfg)
+
+    if args.command == "route":
+        # The router is pure host code — it must come up (and stay up)
+        # on a machine whose accelerator stack is the thing that is
+        # broken, so no parallel.initialize() here.
+        from tpu_resnet.serve.router import (read_route_port,
+                                             request_drain, route)
+        if args.drain:
+            url = args.router_url
+            if not url:
+                port = read_route_port(cfg.route.discover_dir
+                                       or cfg.train.train_dir)
+                if port is None:
+                    parser.error("route --drain: no route.json found; "
+                                 "pass --router-url or "
+                                 "route.discover_dir=<dir>")
+                url = f"http://127.0.0.1:{port}"
+            result = request_drain(url, args.drain)
+            print(json.dumps(result))
+            return 0 if result.get("ok") else 1
+        return route(cfg)
 
     if args.command == "inspect":
         from tpu_resnet.tools.inspect_ckpt import main as inspect_main

@@ -127,6 +127,41 @@ SERVE_GAUGES = (
     ("serve_reloads_total", "Checkpoint hot-reloads completed"),
 )
 
+# Router gauge set (tpu_resnet/serve/router.py; docs/SERVING.md "Serving
+# fleet"). The front router runs the same registry/HTTP stack on its own
+# port — /healthz is 503 while no replica is healthy.
+ROUTE_GAUGES = (
+    ("route_requests_total", "Predict requests accepted by the router"),
+    ("route_requests_ok", "Requests answered 2xx end to end"),
+    ("route_requests_failed", "Requests that exhausted replicas/retries "
+                              "or blew the deadline budget"),
+    ("route_retries_total", "Failover retries sent to a second replica "
+                            "(connect failure / 5xx / deadline)"),
+    ("route_hedges_total", "Hedged duplicate sends fired (requests "
+                           "sitting past the hedge threshold)"),
+    ("route_hedge_wins_total", "Hedged sends whose duplicate answered "
+                               "first"),
+    ("route_shed_total", "Requests shed by SLO admission (rolling p99 "
+                         "over route.slo_ms) -> HTTP 429"),
+    ("route_shed_batch_total", "Batch-lane requests shed (lowest "
+                               "priority sheds first)"),
+    ("route_shed_interactive_total", "Interactive-lane requests shed "
+                                     "(p99 past slo*shed_hard_factor)"),
+    ("route_replica_errors_total", "Passive replica failures observed "
+                                   "(connect/5xx/timeout)"),
+    ("route_replicas_total", "Replicas known to the router (static + "
+                             "discovered)"),
+    ("route_replicas_healthy", "Replicas currently in rotation (circuit "
+                               "closed, not draining)"),
+    ("route_inflight", "Requests currently in flight across replicas"),
+    ("route_p50_ms", "Rolling p50 end-to-end router latency"),
+    ("route_p99_ms", "Rolling p99 end-to-end router latency (the shed/"
+                     "hedge signal)"),
+    ("route_slo_ms", "Configured p99 SLO target (0 = shedding off)"),
+    ("route_lane_interactive_total", "Interactive-lane requests routed"),
+    ("route_lane_batch_total", "Batch-lane requests routed"),
+)
+
 
 # Histogram bucket edges (upper bounds; +Inf is implicit). Latencies in
 # ms span sub-ms CPU inference to multi-second stragglers; the fraction
@@ -150,6 +185,13 @@ SERVE_HISTOGRAMS = (
     ("serve_pad_fraction", "Padded fraction of each dispatched bucket "
                            "(compile-avoidance cost per batch)",
      FRACTION_BUCKETS),
+)
+ROUTE_HISTOGRAMS = (
+    ("route_latency_ms", "End-to-end router latency (accept to client "
+                         "response, retries/hedges included)",
+     LATENCY_BUCKETS_MS),
+    ("route_upstream_ms", "Single upstream attempt latency per replica "
+                          "send", LATENCY_BUCKETS_MS),
 )
 
 

@@ -24,7 +24,11 @@ Lanes (Chrome trace "processes"/"threads"):
 - **eval sidecar** (``eval/events.jsonl``): eval_pass/restore spans. An
   in-process sidecar (train_and_eval) shares the trainer's pid and shows
   up as another thread of the same process — which is the truth.
-- **serve** (``serve_events.jsonl``): warmup, hot-reload, drain spans.
+- **serve** (``serve_events.jsonl``): warmup, hot-reload, drain spans —
+  one lane per replica pid when a fleet shares the train_dir.
+- **router** (``route_events.jsonl``): the serving fleet's front router
+  (serve/router.py) — replica up/down transitions, drain spans, shed
+  events, laid beside the replica lanes they caused.
 - **device-memory** (counter thread on the trainer lane): the live
   ``hbm_bytes_in_use``/``hbm_bytes_peak``/``hbm_utilization`` gauges the
   loop samples from ``device.memory_stats()`` at log boundaries
@@ -62,12 +66,13 @@ from typing import Dict, List, Optional, Tuple
 from tpu_resnet.obs.spans import load_jsonl, load_spans
 
 SERVE_EVENTS_FILE = "serve_events.jsonl"
+ROUTE_EVENTS_FILE = "route_events.jsonl"
 TRACE_FILE = "trace.json"
 
 # Synthetic lane ids used when a source file predates pid stamping.
-_FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3}
+_FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3, "route": 4}
 # Thread ids within a lane (Chrome traces key threads by (pid, tid)).
-_TID_SPANS = {"train": 1, "eval": 11, "serve": 21}
+_TID_SPANS = {"train": 1, "eval": 11, "serve": 21, "route": 31}
 _TID_BREAKDOWN = 2
 _TID_ENGINE = 3
 # Dedicated transfer lane: h2d_transfer spans (the double-buffered
@@ -124,7 +129,7 @@ def _us(wall: float, base: float) -> float:
 def _span_events(spans: List[dict], source: str, base: float,
                  pid_of: Dict[str, int]) -> List[dict]:
     events = []
-    pid = pid_of[source]
+    default_pid = pid_of[source]
     for s in spans:
         try:
             start, end = float(s["start"]), float(s["end"])
@@ -135,6 +140,14 @@ def _span_events(spans: List[dict], source: str, base: float,
         name = str(s.get("span", "span"))
         tid = (_TID_H2D if source == "train" and name == _H2D_SPAN
                else _TID_SPANS[source])
+        # Fleet sources (serve replicas sharing one serve_events.jsonl,
+        # the router): each writer pid keeps its OWN lane so a rolling
+        # drain renders as N replica lanes + a router lane, not one
+        # merged smear. Train/eval keep the single-lane behavior (their
+        # multi-pid case is supervised restarts of the same logical
+        # process, reviewed as one lane on purpose).
+        pid = (s["pid"] if source in ("serve", "route")
+               and isinstance(s.get("pid"), int) else default_pid)
         args = {k: v for k, v in s.items()
                 if k not in ("span", "start", "end", "pid")}
         common = {"name": name, "cat": source,
@@ -347,6 +360,7 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
         "eval": load_spans(os.path.join(train_dir, "eval",
                                         "events.jsonl")),
         "serve": load_spans(os.path.join(train_dir, SERVE_EVENTS_FILE)),
+        "route": load_spans(os.path.join(train_dir, ROUTE_EVENTS_FILE)),
     }
     metrics = load_jsonl(os.path.join(train_dir, "metrics.jsonl"), "step")
 
@@ -385,17 +399,31 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
     run_id = manifest_run_id or next(
         (ids[0] for ids in source_run_ids.values() if ids), None)
 
-    labels = {"train": "trainer", "eval": "eval-sidecar", "serve": "serve"}
+    labels = {"train": "trainer", "eval": "eval-sidecar",
+              "serve": "serve", "route": "router"}
     for src, spans in sources.items():
         if not spans and not (src == "train" and metrics):
             continue
         pid = pid_of[src]
         rid = (source_run_ids.get(src) or [run_id or ""])[0]
         suffix = f" run={rid}" if rid else ""
-        events.append(_meta("process_name", pid,
-                            label=f"{labels[src]}{suffix}"))
-        events.append(_meta("thread_name", pid, _TID_SPANS[src],
-                            f"{labels[src]}-spans"))
+        if src in ("serve", "route"):
+            # One lane per writer pid (replica): labels carry the pid
+            # when more than one replica appended to the shared file.
+            pids = sorted({s["pid"] for s in spans
+                           if isinstance(s.get("pid"), int)}) or [pid]
+            for p in pids:
+                label = (labels[src] if len(pids) == 1
+                         else f"{labels[src]}[{p}]")
+                events.append(_meta("process_name", p,
+                                    label=f"{label}{suffix}"))
+                events.append(_meta("thread_name", p, _TID_SPANS[src],
+                                    f"{labels[src]}-spans"))
+        else:
+            events.append(_meta("process_name", pid,
+                                label=f"{labels[src]}{suffix}"))
+            events.append(_meta("thread_name", pid, _TID_SPANS[src],
+                                f"{labels[src]}-spans"))
         if src == "train" and any(s.get("span") == _H2D_SPAN
                                   for s in spans):
             events.append(_meta("thread_name", pid, _TID_H2D,

@@ -6,7 +6,10 @@ deterministic step, so the drill tests (tests/test_resilience_drills.py)
 and ``doctor --fault-drill`` can prove every path end-to-end: NaN batch →
 sentinel rollback; data stall → watchdog fires and the stream recovers;
 SIGTERM → graceful save + distinct exit code + resume; corrupt checkpoint
-→ restore fallback.
+→ restore fallback. The serve-side faults (slow inference, accept-then-
+hang, SIGKILL at request K) are the same idea pointed at the predict
+fleet: ``doctor --fleet-probe`` and the loadgen chaos scenarios use them
+to prove the router's failover/eviction paths (docs/SERVING.md).
 
 Everything is **off by default**: an empty plan wraps nothing and costs
 nothing. Sources, in precedence order:
@@ -51,19 +54,29 @@ class FaultPlan:
     oom_at_step: int = -1        # synthetic RESOURCE_EXHAUSTED at boundary
     preempt_burst: int = 0       # K SIGTERMs total across supervised runs
     preempt_burst_every: int = 10  # each fires this many steps after start
+    # ---- serve-side faults (fleet chaos drills; serve/server.py) ----
+    serve_slow_ms: float = 0.0       # extra latency per inference batch
+    serve_hang_at_request: int = -1  # accept, then hang at request K
+    serve_kill_at_request: int = -1  # SIGKILL self at request K
 
     @property
     def active(self) -> bool:
         return (self.nan_at_step >= 0 or self.sigterm_at_step >= 0
                 or (self.stall_at_step >= 0 and self.stall_seconds > 0)
                 or self.corrupt_ckpt_at_start or self.oom_at_step >= 0
-                or self.preempt_burst > 0)
+                or self.preempt_burst > 0 or self.serves_faults)
+
+    @property
+    def serves_faults(self) -> bool:
+        return (self.serve_slow_ms > 0 or self.serve_hang_at_request >= 0
+                or self.serve_kill_at_request >= 0)
 
     @classmethod
     def from_config(cls, resilience_cfg, env=None) -> "FaultPlan":
         """Config fields overridden by ``TPU_RESNET_FAULT_*`` env vars:
         NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT,
-        OOM_STEP, PREEMPT_BURST, PREEMPT_BURST_EVERY."""
+        OOM_STEP, PREEMPT_BURST, PREEMPT_BURST_EVERY, SERVE_SLOW_MS,
+        SERVE_HANG_REQ, SERVE_KILL_REQ."""
         env = os.environ if env is None else env
         r = resilience_cfg
 
@@ -85,6 +98,14 @@ class FaultPlan:
                                r.inject_preempt_burst, int),
             preempt_burst_every=pick("PREEMPT_BURST_EVERY",
                                      r.inject_preempt_burst_every, int),
+            serve_slow_ms=pick("SERVE_SLOW_MS",
+                               r.inject_serve_slow_ms, float),
+            serve_hang_at_request=pick("SERVE_HANG_REQ",
+                                       r.inject_serve_hang_at_request,
+                                       int),
+            serve_kill_at_request=pick("SERVE_KILL_REQ",
+                                       r.inject_serve_kill_at_request,
+                                       int),
         )
 
 
@@ -105,6 +126,8 @@ class FaultInjector:
         self._oom_fired = False
         self._burst_start_step = None  # first boundary this process saw
         self._burst_spent = False      # caches fired >= K (no re-reads)
+        self._serve_requests = 0       # predict requests admitted so far
+        self._serve_hung = False
         if plan.active:
             log.warning("FAULT INJECTION ACTIVE: %s", plan)
 
@@ -213,6 +236,50 @@ class FaultInjector:
         log.warning("injecting preemption burst SIGTERM %d/%d at step %d",
                     fired + 1, self.plan.preempt_burst, step)
         os.kill(os.getpid(), signal.SIGTERM)
+
+    # ---------------------------------------------------- serve faults
+    def wrap_serve_infer(self, infer_fn):
+        """Wrap the predict server's inference callable with the planned
+        serve-side faults, counted in predict *requests* (the server
+        ticks :meth:`note_serve_request` per admitted request; the wrap
+        itself only adds the slow/hang behavior at dispatch time so the
+        batcher thread is the thread that hangs — the accept-then-hang
+        shape the router must ride). Returns ``infer_fn`` untouched when
+        no serve fault is planned: zero overhead, identical callable."""
+        if not self.plan.serves_faults:
+            return infer_fn
+
+        def wrapped(images):
+            if (self.plan.serve_hang_at_request >= 0
+                    and self._serve_requests
+                    >= self.plan.serve_hang_at_request):
+                if not self._serve_hung:
+                    self._serve_hung = True
+                    log.warning("injecting serve hang at request %d "
+                                "(batcher thread sleeps; requests keep "
+                                "being accepted and time out)",
+                                self._serve_requests)
+                while True:          # hung for good: the drill target is
+                    time.sleep(60)   # probe-driven eviction, not recovery
+            if self.plan.serve_slow_ms > 0:
+                time.sleep(self.plan.serve_slow_ms / 1e3)
+            return infer_fn(images)
+
+        return wrapped
+
+    def note_serve_request(self) -> None:
+        """Count one admitted predict request; fires the hard-kill fault
+        (SIGKILL — no drain, no exit handler: the replica death the
+        failover drill rides) when the plan says this is request K."""
+        self._serve_requests += 1
+        if (self.plan.serve_kill_at_request >= 0
+                and self._serve_requests
+                >= self.plan.serve_kill_at_request):
+            import signal
+
+            log.warning("injecting serve SIGKILL at request %d",
+                        self._serve_requests)
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def maybe_oom(self, step: int) -> None:
         """Raise a synthetic RESOURCE_EXHAUSTED at the first chunk

@@ -9,6 +9,11 @@
              checkpoint dir with poll + atomic hot-reload.
 ``server``   HTTP front end + /metrics + /healthz readiness + SIGTERM
              drain; the ``tpu_resnet serve`` CLI entry.
+``router``   the serving-fleet front: spreads /predict over N replicas
+             with health-probed circuit breakers, deadline-budgeted
+             failover, hedging, SLO-aware lane shedding, and rolling
+             drains; the ``tpu_resnet route`` CLI entry. Stdlib-only —
+             never imports jax (the jaxlint host-isolation contract).
 
 Lazy re-exports (PEP 562) keep ``import tpu_resnet.serve`` jax-free so
 stdlib-only consumers (loadgen, the doctor probe) can import the
@@ -20,10 +25,15 @@ __all__ = [
     "MicroBatcher",
     "PredictServer",
     "QueueFull",
+    "Router",
     "build_backend",
     "default_buckets",
+    "discover_replicas",
     "parse_predict_body",
+    "read_route_port",
     "read_serve_port",
+    "request_drain",
+    "route",
     "serve",
 ]
 
@@ -37,6 +47,11 @@ _LAZY = {
     "read_serve_port": "tpu_resnet.serve.server",
     "serve": "tpu_resnet.serve.server",
     "build_backend": "tpu_resnet.serve.backend",
+    "Router": "tpu_resnet.serve.router",
+    "discover_replicas": "tpu_resnet.serve.router",
+    "read_route_port": "tpu_resnet.serve.router",
+    "request_drain": "tpu_resnet.serve.router",
+    "route": "tpu_resnet.serve.router",
 }
 
 

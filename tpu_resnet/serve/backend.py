@@ -25,6 +25,7 @@ reports ready — no mid-traffic recompiles), ``maybe_reload() -> bool``,
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Sequence, Tuple
 
@@ -109,6 +110,15 @@ class CheckpointBackend:
                                        keep=cfg.train.keep_checkpoints)
         self._poller = CheckpointPoller(cfg.train.train_dir)
         self._infer_fn = make_serve_infer(cfg)
+        # Swap/teardown ordering: a hot-reload swap (batcher thread) and
+        # close() (drain/shutdown path, another thread) must serialize —
+        # closing the checkpoint manager UNDER a mid-flight restore
+        # would abort the swap half-done. Lock order is always
+        # swap-then-manager; infer never takes the lock (it reads the
+        # already-atomic _variables reference), so the serving hot path
+        # pays nothing.
+        self._swap_lock = threading.Lock()
+        self._closed = False
         self._variables = None
         step = latest_step_in(cfg.train.train_dir)
         if step is None:
@@ -125,18 +135,27 @@ class CheckpointBackend:
 
         res = self._cfg.resilience
         t0 = time.monotonic()
-        state = restore_with_retry(
-            self._ckpt, self._template, step,
-            retries=res.eval_restore_retries,
-            backoff_sec=res.eval_restore_backoff_sec)
-        if state is None:
-            return False
-        # The swap is a single reference assignment; the batcher calls
-        # maybe_reload() strictly between batches, so no in-flight
-        # inference can observe a half-built variables dict.
-        self._variables = {"params": state.params,
-                           "batch_stats": state.batch_stats}
-        self.model_step = int(step)
+        with self._swap_lock:
+            if self._closed:
+                # Drain won the race: the manager is (about to be) gone.
+                # Abort cleanly — the old variables stay served, never a
+                # half-swapped pair.
+                return False
+            state = restore_with_retry(
+                self._ckpt, self._template, step,
+                retries=res.eval_restore_retries,
+                backoff_sec=res.eval_restore_backoff_sec)
+            if state is None:
+                return False
+            # The swap is a single reference assignment; the batcher
+            # calls maybe_reload() strictly between batches, so no
+            # in-flight inference can observe a half-built variables
+            # dict — and the lock means close() can never tear the
+            # manager down UNDER this restore (the drain-during-reload
+            # contract: finish the swap or abort it cleanly).
+            self._variables = {"params": state.params,
+                               "batch_stats": state.batch_stats}
+            self.model_step = int(step)
         self._poller.mark_seen(step)
         log.info("serve: loaded checkpoint step %d (%.2fs)", step,
                  time.monotonic() - t0)
@@ -178,7 +197,14 @@ class CheckpointBackend:
         return False
 
     def close(self) -> None:
-        self._ckpt.close()
+        """Blocks until any in-flight hot-reload swap completes (or
+        aborts), then closes the checkpoint manager — see the
+        ``_swap_lock`` ordering note in ``__init__``."""
+        with self._swap_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._ckpt.close()
 
 
 def build_backend(cfg: RunConfig, mesh=None):

@@ -40,6 +40,14 @@ class Draining(Exception):
     to HTTP 503."""
 
 
+# QoS lanes, highest priority first. An "interactive" tenant's requests
+# always coalesce ahead of queued "batch" work (the router threads the
+# X-Lane header through to here), so a bulk tenant can fill the queue
+# without adding a single batch-service-time of latency to the
+# interactive lane — the lane the SLO is written against.
+LANES = ("interactive", "batch")
+
+
 def default_buckets(max_batch: int) -> Tuple[int, ...]:
     """Powers of two up to ``max_batch`` (plus ``max_batch`` itself when
     it is not one) — a handful of compiled shapes covers every coalesced
@@ -142,8 +150,12 @@ class MicroBatcher:
         self._between = between_batches
         self._on_stats = on_stats
         self._idle_tick = idle_tick_sec
-        self._queue: "queue.Queue[PendingRequest]" = queue.Queue(
+        # Priority queue of (lane_priority, seq, request): the seq
+        # tiebreak keeps FIFO order inside a lane and guarantees two
+        # entries never compare their PendingRequest payloads.
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue(
             maxsize=max_queue)
+        self._seq = 0  # monotonically increasing under _admit_lock
         self._carry: Optional[PendingRequest] = None  # worker-thread only
         self._accepting = True
         # Serializes admission against the drain flip: every put happens
@@ -156,6 +168,7 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._counters = dict(requests=0, images=0, batches=0, failed=0,
                               rejected=0, padded_images=0, batched_images=0)
+        self._lane_counts = {lane: 0 for lane in LANES}
         self._last_batch = 0
         self._latencies: List[float] = []
         self._latency_ring = max(1, int(latency_ring))
@@ -173,22 +186,27 @@ class MicroBatcher:
                              f"images, got {images.shape[0]} "
                              f"(split larger requests)")
 
-    def submit(self, images: np.ndarray) -> PendingRequest:
+    def submit(self, images: np.ndarray,
+               lane: str = "interactive") -> PendingRequest:
         """Enqueue ``images`` (uint8 [n,H,W,C], 1 <= n <= max_batch).
         Raises :class:`Draining` when shut down, :class:`QueueFull` when
         the bounded queue is at capacity (backpressure, not latency)."""
-        return self.submit_many([images])[0]
+        return self.submit_many([images], lane=lane)[0]
 
-    def submit_many(self, chunks: Sequence[np.ndarray]
-                    ) -> List[PendingRequest]:
+    def submit_many(self, chunks: Sequence[np.ndarray],
+                    lane: str = "interactive") -> List[PendingRequest]:
         """Admit several requests atomically: either every chunk gets a
         queue slot or none does (QueueFull). This is how an oversize
         request split across batches is admitted — a partial admission
         would run the admitted chunks' inference only to throw the
         results away when the client sees the 429 and retries the whole
-        request."""
+        request. ``lane`` is the QoS class (:data:`LANES`): interactive
+        work coalesces ahead of everything queued in the batch lane."""
+        if lane not in LANES:
+            raise ValueError(f"unknown lane {lane!r} (have {LANES})")
         for images in chunks:
             self._validate(images)
+        priority = LANES.index(lane)
         with self._admit_lock:
             if not self._accepting:
                 raise Draining("server is draining")
@@ -201,10 +219,12 @@ class MicroBatcher:
                                 f"({self._queue.maxsize})")
             reqs = [PendingRequest(images) for images in chunks]
             for req in reqs:
-                self._queue.put_nowait(req)
+                self._seq += 1
+                self._queue.put_nowait((priority, self._seq, req))
         with self._lock:
             self._counters["requests"] += len(reqs)
             self._counters["images"] += sum(r.n for r in reqs)
+            self._lane_counts[lane] += len(reqs)
         return reqs
 
     def queue_depth(self) -> int:
@@ -225,7 +245,7 @@ class MicroBatcher:
             first, self._carry = self._carry, None
         else:
             try:
-                first = self._queue.get(timeout=self._idle_tick)
+                first = self._queue.get(timeout=self._idle_tick)[2]
             except queue.Empty:
                 return []
         reqs, total = [first], first.n
@@ -239,8 +259,8 @@ class MicroBatcher:
             if self._stop.is_set():
                 remaining = 0.0  # draining: flush, don't dawdle
             try:
-                nxt = self._queue.get(timeout=max(0.0, remaining)) \
-                    if remaining > 0 else self._queue.get_nowait()
+                nxt = (self._queue.get(timeout=max(0.0, remaining))
+                       if remaining > 0 else self._queue.get_nowait())[2]
             except queue.Empty:
                 break
             if total + nxt.n > self.max_batch:
@@ -336,7 +356,7 @@ class MicroBatcher:
         # until the handler's wait timeout instead of an immediate 503.
         while True:
             try:
-                req = self._queue.get_nowait()
+                req = self._queue.get_nowait()[2]
             except queue.Empty:
                 break
             req.set_error(Draining("server shut down before this "
@@ -359,12 +379,14 @@ class MicroBatcher:
     def stats(self) -> Dict:
         with self._lock:
             c = dict(self._counters)
+            lanes = dict(self._lane_counts)
             lat = sorted(self._latencies)
             last = self._last_batch
         batches = max(1, c["batches"])
         denom = max(1, c["batched_images"] + c["padded_images"])
         return {
             **c,
+            **{f"lane_{lane}": n for lane, n in lanes.items()},
             "queue_depth": self.queue_depth(),
             "batch_size_last": last,
             "batch_size_mean": c["batched_images"] / batches,

@@ -35,8 +35,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
-import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -50,8 +48,9 @@ from tpu_resnet.obs.manifest import read_run_id
 from tpu_resnet.obs.server import (SERVE_GAUGES, SERVE_HISTOGRAMS,
                                    TelemetryRegistry)
 from tpu_resnet.obs.spans import SpanTracer
-from tpu_resnet.serve.batcher import (Draining, MicroBatcher, QueueFull,
-                                      default_buckets)
+from tpu_resnet.resilience.faultinject import FaultInjector, FaultPlan
+from tpu_resnet.serve.batcher import (LANES, Draining, MicroBatcher,
+                                      QueueFull, default_buckets)
 
 log = logging.getLogger("tpu_resnet")
 
@@ -125,9 +124,14 @@ class PredictServer:
             tuple(sorted({int(b) for b in raw})))
         self.image_shape = (self.backend.image_size,
                             self.backend.image_size, 3)
+        # Staleness = serve.healthz_stale_sec, NOT the trainer's 300 s:
+        # the heartbeat is ticked by the batcher thread (per batch and
+        # per idle tick), so a wedged inference worker goes dark within
+        # seconds — /healthz must report it before a router's half-open
+        # probe would flap the hung replica back into rotation.
         self.registry = registry if registry is not None \
             else TelemetryRegistry(
-                stale_after_sec=cfg.train.telemetry_stale_sec,
+                stale_after_sec=cfg.serve.healthz_stale_sec,
                 gauges=SERVE_GAUGES, histograms=SERVE_HISTOGRAMS)
         # Serve-side timeline (serve_events.jsonl) + correlation id: the
         # run_id of the train_dir being served, stamped on spans and
@@ -139,8 +143,15 @@ class PredictServer:
             "loading: compiling bucketed batch shapes")
         self._reload_every = float(cfg.serve.reload_interval_secs)
         self._next_reload = time.monotonic() + self._reload_every
+        # Serve-side fault injection (resilience/faultinject.py; off by
+        # default and free when off): slow-infer latency, accept-then-
+        # hang, and SIGKILL-at-request-K — the chaos levers the fleet
+        # drills (doctor --fleet-probe, loadgen scenarios) pull.
+        self._injector = FaultInjector(
+            FaultPlan.from_config(cfg.resilience), cfg.train.train_dir)
         self.batcher = MicroBatcher(
-            self.backend.infer, self.image_shape,
+            self._injector.wrap_serve_infer(self.backend.infer),
+            self.image_shape,
             max_batch=max(self.buckets), max_wait_ms=cfg.serve.max_wait_ms,
             buckets=self.buckets, max_queue=cfg.serve.max_queue,
             between_batches=self._between_batches,
@@ -263,31 +274,51 @@ class PredictServer:
         })
 
     # ---------------------------------------------------------- predict
-    def predict(self, images: np.ndarray) -> np.ndarray:
+    def predict(self, images: np.ndarray,
+                lane: str = "interactive") -> np.ndarray:
         """Submit ``images`` through the batcher (splitting requests
         larger than the biggest bucket) and block for the logits. The
         chunks are admitted atomically — a request that doesn't fully
-        fit is rejected before any of its inference runs."""
+        fit is rejected before any of its inference runs. ``lane`` is
+        the QoS class: batch-lane work coalesces behind everything
+        queued in the interactive lane."""
         max_b = self.batcher.max_batch
         pending = self.batcher.submit_many(
             [images[i:i + max_b]
-             for i in range(0, images.shape[0], max_b)])
+             for i in range(0, images.shape[0], max_b)], lane=lane)
         return np.concatenate([p.wait(REQUEST_WAIT_SEC) for p in pending])
 
+    def retry_after_secs(self) -> int:
+        """Honest backpressure hint for 429/503 responses: the seconds a
+        full queue needs to drain at the recent per-request service
+        rate, floored at 1 — so a retrying client (or the router's
+        shed/backoff) waits roughly one queue-drain, not a blind
+        constant."""
+        stats = self.batcher.stats()
+        p50_sec = stats["latency_p50_ms"] / 1e3
+        depth = stats["queue_depth"]
+        mean_batch = max(1.0, stats["batch_size_mean"])
+        return max(1, int(round(depth * p50_sec / mean_batch)))
+
     def handle_predict(self, body: bytes, content_type: str,
-                       shape_header: Optional[str],
-                       want_logits: bool) -> Tuple[int, dict]:
+                       shape_header: Optional[str], want_logits: bool,
+                       lane: str = "interactive") -> Tuple[int, dict]:
         """(status, response-json) for one predict call — pure enough to
-        unit test without sockets."""
+        unit test without sockets. ``lane`` comes from the X-Lane header
+        (unknown values fall back to interactive, the strict lane)."""
+        if lane not in LANES:
+            lane = "interactive"
+        self._injector.note_serve_request()
         try:
             images = parse_predict_body(body, content_type, shape_header,
                                         self.image_shape)
         except ValueError as e:
             return 400, {"error": str(e)}
         try:
-            logits = self.predict(images)
+            logits = self.predict(images, lane=lane)
         except QueueFull as e:
-            return 429, {"error": str(e), "retryable": True}
+            return 429, {"error": str(e), "retryable": True,
+                         "retry_after_secs": self.retry_after_secs()}
         except Draining as e:
             return 503, {"error": str(e)}
         except TimeoutError as e:
@@ -305,9 +336,11 @@ class PredictServer:
         return 200, out
 
     def info(self) -> dict:
+        stats = self.batcher.stats()
         return {
             "backend": type(self.backend).__name__,
             "run_id": self.run_id,
+            "replica_name": self.cfg.serve.replica_name,
             "model_step": int(self.backend.model_step),
             "reloads": int(self.backend.reloads),
             "image_shape": list(self.image_shape),
@@ -315,25 +348,25 @@ class PredictServer:
             "buckets": list(self.buckets),
             "max_wait_ms": self.cfg.serve.max_wait_ms,
             "max_queue": self.cfg.serve.max_queue,
-            "stats": self.batcher.stats(),
+            # Top-level copy: the router's passive queue-pressure signal
+            # reads one /info — no second /metrics scrape in the probe
+            # loop (the full stats dict stays nested below).
+            "queue_depth": stats["queue_depth"],
+            "stats": stats,
         }
 
     # ---------------------------------------------------------- HTTP layer
     def _make_handler(self):
         server = self
+        from tpu_resnet.serve.discovery import send_json
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def _send(self, code: int, payload: dict,
-                      ctype: str = "application/json"):
-                body = json.dumps(payload).encode() \
-                    if not isinstance(payload, bytes) else payload
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                      ctype: str = "application/json",
+                      extra_headers: Optional[dict] = None):
+                send_json(self, code, payload, ctype, extra_headers)
 
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
@@ -364,8 +397,17 @@ class PredictServer:
                 code, payload = server.handle_predict(
                     body, self.headers.get("Content-Type", ""),
                     self.headers.get("X-Shape"),
-                    want_logits="logits=1" in query)
-                self._send(code, payload)
+                    want_logits="logits=1" in query,
+                    lane=(self.headers.get("X-Lane")
+                          or "interactive").strip().lower())
+                headers = None
+                if code == 429:
+                    # Backpressure responses carry Retry-After so a
+                    # client (or the router) backs off for one honest
+                    # queue-drain instead of hammering the full queue.
+                    headers = {"Retry-After": payload.get(
+                        "retry_after_secs", 1)}
+                self._send(code, payload, extra_headers=headers)
 
             def log_message(self, *args):  # request logs would swamp stderr
                 pass
@@ -374,25 +416,25 @@ class PredictServer:
 
 
 def write_discovery(train_dir: str, port: int,
-                    run_id: Optional[str] = None) -> None:
+                    run_id: Optional[str] = None,
+                    name: str = "") -> None:
     """Atomic ``<train_dir>/serve.json`` — the telemetry.json analog for
-    the predict server (loadgen/doctor dial the port from here)."""
-    os.makedirs(train_dir, exist_ok=True)
-    record = {"port": port, "pid": os.getpid(), "run_id": run_id,
-              "hostname": socket.gethostname(), "started_at": time.time()}
-    path = os.path.join(train_dir, SERVE_DISCOVERY)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(record, f)
-    os.replace(tmp, path)
+    the predict server (loadgen/doctor dial the port from here). A
+    nonempty ``name`` (serve.replica_name) writes
+    ``serve-<name>.json`` instead, so N replicas sharing one train_dir
+    each announce themselves and the router (serve/router.py) discovers
+    the whole fleet from one directory scan."""
+    from tpu_resnet.serve.discovery import write_record
+
+    write_record(train_dir,
+                 f"serve-{name}.json" if name else SERVE_DISCOVERY,
+                 port, extra={"run_id": run_id, "name": name or None})
 
 
 def read_serve_port(train_dir: str) -> Optional[int]:
-    try:
-        with open(os.path.join(train_dir, SERVE_DISCOVERY)) as f:
-            return int(json.load(f)["port"])
-    except (OSError, ValueError, KeyError):
-        return None
+    from tpu_resnet.serve.discovery import read_port
+
+    return read_port(train_dir, SERVE_DISCOVERY)
 
 
 def serve(cfg: RunConfig) -> int:
@@ -442,7 +484,8 @@ def serve(cfg: RunConfig) -> int:
             spans.close()
             raise
         write_discovery(cfg.train.train_dir, server.port,
-                        run_id=server.run_id)
+                        run_id=server.run_id,
+                        name=cfg.serve.replica_name)
         log.info("serve: ready on :%d — backend=%s model_step=%d "
                  "buckets=%s max_wait_ms=%s (POST /predict; /metrics; "
                  "/healthz)", server.port, cfg.serve.backend,

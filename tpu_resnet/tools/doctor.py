@@ -43,6 +43,15 @@ Checks:
              and verify the graceful drain exits 0. Proves the whole
              serving contract (tpu_resnet/serve; docs/SERVING.md) on
              this machine before a real deployment bets on it.
+  fleet_probe  optional (--fleet-probe): serving-fleet resilience drill
+             (tpu_resnet/serve/router.py) — 2 serve replicas + the
+             front router on ephemeral ports, 8 clients through the
+             router, SIGKILL one replica mid-traffic (zero client
+             failures, circuit opens within ~a probe interval), a
+             checkpoint hot-reload on the survivor, a rolling admin
+             drain (replica exits 0), router SIGTERM exit 0, and a
+             trace-export check that router + replica lanes landed on
+             one run_id-correlated timeline (docs/SERVING.md)
   trace_probe  optional (--trace-probe): a live observability drill —
              tiny CPU train with telemetry up, /metrics scraped MID-RUN
              until the live mfu gauge and train_step_ms histogram carry
@@ -411,6 +420,310 @@ def _check_serve_probe(timeout: int = 300) -> dict:
             if proc.poll() is None:
                 proc.kill()
             log_fh.close()
+
+
+def _check_fleet_probe(timeout: int = 420) -> dict:
+    """Serving-fleet resilience drill (tpu_resnet/serve/router.py) in
+    scrubbed-CPU subprocesses — the replica-kill chaos + rolling-drain
+    acceptance contract on this box:
+
+    1. train a tiny MLP, start TWO serve replicas (serve.replica_name=
+       r0/r1, ephemeral ports, shared train_dir) and the front router
+       (route.discover_dir) — wait until the router reports both
+       replicas healthy;
+    2. run 8 closed-loop clients against the ROUTER and SIGKILL r0
+       mid-traffic: every client request must still answer 200 (the
+       in-flight failover retry covers the kill window), and the
+       router's circuit must exclude r0 within ~one probe interval
+       (route_replicas_healthy drops to 1);
+    3. land a newer checkpoint so the survivor hot-reloads (the
+       serve_reload span the rolling-ops timeline needs), then drain r1
+       THROUGH the router's admin endpoint — the replica must exit 0
+       (the PR 2/5 drain contract) with zero failed requests;
+    4. SIGTERM the router (exit 0), then trace-export the train_dir:
+       the merged timeline must carry router + replica lanes
+       (route_drain, serve_reload, serve_drain, replica_down spans),
+       all correlated by the run's run_id."""
+    import signal
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
+    from tpu_resnet.obs.server import parse_prometheus
+    from tpu_resnet.obs.trace import export_trace
+    from tpu_resnet.serve.router import discover_replicas, read_route_port
+
+    ns = "tpu_resnet_"
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_fleet_") as d:
+        # Flags first, positional overrides contiguous after (argparse
+        # rejects interleaved positionals around optionals).
+        model_over = [f"train.train_dir={d}", "model.name=mlp",
+                      "data.device_resident=off", "data.transfer_stage=1"]
+        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
+                     "--preset", "smoke",
+                     "train.train_steps=6", "train.checkpoint_every=3",
+                     "train.log_every=3", "train.summary_every=6",
+                     "train.image_summary_every=0",
+                     "train.steps_per_call=3"] + model_over
+        rc, out = run_scrubbed_subprocess(train_cmd, n_devices=1,
+                                          timeout=timeout)
+        if rc != 0:
+            return {"ok": False, "phase": "train", "rc": rc,
+                    "tail": out.strip().splitlines()[-5:]}
+
+        procs, logs = {}, {}
+
+        def spawn(name, cmd):
+            log_path = os.path.join(d, f"{name}_child.log")
+            fh = open(log_path, "w")
+            logs[name] = (log_path, fh)
+            procs[name] = subprocess.Popen(
+                cmd, env=scrubbed_cpu_env(1), stdout=fh,
+                stderr=subprocess.STDOUT, text=True)
+            return procs[name]
+
+        def tail(name):
+            path, fh = logs[name]
+            fh.flush()
+            try:
+                with open(path) as f:
+                    return f.read().strip().splitlines()[-5:]
+            except OSError:
+                return []
+
+        def fail(phase, **extra):
+            extra.setdefault("tails", {n: tail(n) for n in procs})
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            return {"ok": False, "phase": phase, **extra}
+
+        def get_json(url, t=2):
+            with urllib.request.urlopen(url, timeout=t) as r:
+                return json.loads(r.read())
+
+        try:
+            for name in ("r0", "r1"):
+                spawn(name, [sys.executable, "-m", "tpu_resnet", "serve",
+                             "--preset", "smoke",
+                             f"serve.replica_name={name}", "serve.port=0",
+                             "serve.max_batch=4", "serve.max_wait_ms=5",
+                             "serve.reload_interval_secs=0.5"]
+                      + model_over)
+            spawn("router", [sys.executable, "-m", "tpu_resnet", "route",
+                             "--preset", "smoke",
+                             f"route.discover_dir={d}", "route.port=0",
+                             "route.probe_interval_secs=0.3",
+                             "route.probe_timeout_secs=2",
+                             "route.fail_threshold=1",
+                             "route.open_secs=2"] + model_over)
+            base, healthy = None, 0
+            deadline = time.time() + timeout / 2
+            while time.time() < deadline:
+                if any(p.poll() is not None for p in procs.values()):
+                    return fail("startup", rcs={n: p.poll()
+                                                for n, p in procs.items()})
+                if base is None:
+                    port = read_route_port(d)
+                    if port is not None:
+                        base = f"http://127.0.0.1:{port}"
+                if base is not None:
+                    try:
+                        h = get_json(base + "/healthz")
+                        healthy = int(h.get("replicas_healthy", 0))
+                        if h.get("ok") and healthy >= 2:
+                            break
+                    except (OSError, ValueError):
+                        pass
+                time.sleep(0.3)
+            if healthy < 2:
+                return fail("readiness", replicas_healthy=healthy)
+
+            # -------- the headline drill: 8-client loadgen through the
+            # router, loadgen SIGKILLs r0 at half-duration (--scenario
+            # replica_kill). A watcher thread times the circuit: r0's
+            # own /healthz going connection-refused marks the death, the
+            # router's route_replicas_healthy dropping to 1 marks the
+            # exclusion.
+            r0_url = next(r["url"] for r in discover_replicas(d)
+                          if r["name"] == "r0")
+            watch = {"dead_at": None, "excluded_at": None}
+
+            def watcher():
+                stop_at = time.monotonic() + 60
+                while time.monotonic() < stop_at:
+                    if watch["dead_at"] is None:
+                        try:
+                            with urllib.request.urlopen(
+                                    r0_url + "/healthz", timeout=1) as r:
+                                r.read()
+                        except urllib.error.HTTPError as e:
+                            e.read()
+                        except OSError:
+                            watch["dead_at"] = time.monotonic()
+                    else:
+                        try:
+                            with urllib.request.urlopen(
+                                    base + "/metrics", timeout=2) as r:
+                                m = parse_prometheus(r.read().decode())
+                            if m.get(ns + "route_replicas_healthy") == 1.0:
+                                watch["excluded_at"] = time.monotonic()
+                                return
+                        except (OSError, ValueError):
+                            pass
+                    time.sleep(0.1)
+
+            w = threading.Thread(target=watcher, daemon=True)
+            w.start()
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            out_json = os.path.join(d, "loadgen_replica_kill.json")
+            lg = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "loadgen.py"),
+                 "--url", base, "--clients", "8", "--duration", "8",
+                 "--scenario", "replica_kill", "--fleet-dir", d,
+                 "--deadline-ms", "30000", "--out", out_json],
+                env=scrubbed_cpu_env(1), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, timeout=timeout)
+            w.join(timeout=70)
+            try:
+                with open(out_json) as f:
+                    lg_result = json.load(f)
+            except (OSError, ValueError):
+                return fail("chaos_traffic", rc=lg.returncode,
+                            lg_tail=lg.stdout.strip().splitlines()[-5:])
+            hard = (lg_result["failed"] + lg_result["timeouts"]
+                    + lg_result["connect_failures"])
+            if lg.returncode != 0 or hard or not lg_result["requests_ok"]:
+                return fail("chaos_traffic", rc=lg.returncode,
+                            result={k: lg_result.get(k) for k in
+                                    ("requests_ok", "failed", "timeouts",
+                                     "connect_failures", "chaos")})
+            if not (lg_result.get("chaos") or {}).get("killed"):
+                return fail("chaos_traffic",
+                            error="loadgen never delivered the SIGKILL",
+                            chaos=lg_result.get("chaos"))
+            if watch["excluded_at"] is None:
+                return fail("circuit", error="router never excluded the "
+                                             "killed replica",
+                            watch=watch)
+            excluded_in = round(watch["excluded_at"]
+                                - watch["dead_at"], 2)
+            # perfwatch gates the scenario RESULT_JSON (sweep-shaped
+            # points): one sample -> insufficient_data, never regress.
+            pw = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "perfwatch.py"),
+                 "--sweep", out_json],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=60)
+            if pw.returncode != 0 or \
+                    "sweep:scenario=replica_kill" not in pw.stdout:
+                return fail("perfwatch", rc=pw.returncode,
+                            pw_tail=pw.stdout.strip().splitlines()[-5:])
+            metrics = {}
+            try:
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=5) as r:
+                    metrics = parse_prometheus(r.read().decode())
+            except (OSError, ValueError):
+                pass
+
+            # -------- hot-reload on the survivor, then rolling drain
+            rc, out = run_scrubbed_subprocess(
+                [sys.executable, "-m", "tpu_resnet", "train",
+                 "--preset", "smoke",
+                 "train.train_steps=12", "train.checkpoint_every=3",
+                 "train.log_every=3", "train.summary_every=12",
+                 "train.image_summary_every=0", "train.steps_per_call=3"]
+                + model_over, n_devices=1, timeout=timeout)
+            if rc != 0:
+                return fail("reload_train", rc=rc,
+                            tail_train=out.strip().splitlines()[-5:])
+            reload_deadline = time.time() + 30
+            reloaded = False
+            while time.time() < reload_deadline:
+                try:
+                    if get_json(base + "/info").get("model_step") == 12:
+                        reloaded = True
+                        break
+                except (OSError, ValueError):
+                    pass
+                time.sleep(0.5)
+            if not reloaded:
+                return fail("hot_reload",
+                            error="survivor never served step 12")
+            req = urllib.request.Request(
+                base + "/admin/drain?replica=r1", data=b"{}",
+                method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    drain = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                # 409: the drain itself failed — surface its report.
+                drain = json.loads(e.read())
+            try:
+                r1_rc = procs["r1"].wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                return fail("drain", error="r1 still running after the "
+                                           "router drain", drain=drain)
+            if not drain.get("ok") or r1_rc != 0:
+                return fail("drain", drain=drain, r1_rc=r1_rc)
+
+            # -------- router exit-code contract + merged timeline
+            procs["router"].send_signal(signal.SIGTERM)
+            try:
+                router_rc = procs["router"].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                return fail("router_exit",
+                            error="router ignored SIGTERM for 30s")
+            if router_rc != 0:
+                return fail("router_exit", rc=router_rc)
+            try:
+                _, trace = export_trace(d)
+            except (OSError, ValueError) as e:
+                return fail("trace", error=f"{type(e).__name__}: {e}")
+            names = {e["name"] for e in trace["traceEvents"]}
+            need = {"route_drain", "serve_reload", "serve_drain",
+                    "replica_down"}
+            if not need <= names:
+                return fail("trace", missing=sorted(need - names))
+            run_ids = trace["metadata"]["source_run_ids"]
+            correlated = (len(run_ids.get("serve", [])) == 1
+                          and run_ids.get("route") == run_ids["serve"])
+            result = {"ok": bool(correlated),
+                      "requests_ok": lg_result["requests_ok"],
+                      "client_failures": 0,
+                      "killed": lg_result["chaos"]["killed"],
+                      "excluded_in_sec": excluded_in,
+                      "p99_ms": lg_result["latency_ms"]["p99"],
+                      "retries": int(metrics.get(
+                          ns + "route_retries_total", 0)),
+                      "perfwatch_ingested": True,
+                      "survivor_model_step": 12,
+                      "drain": {k: drain.get(k) for k in
+                                ("ok", "replica", "replica_gone")},
+                      "r1_rc": r1_rc, "router_rc": router_rc,
+                      "trace_run_ids": run_ids}
+            if not correlated:
+                result["phase"] = "trace_run_ids"
+            return result
+        finally:
+            # r0 was SIGKILLed mid-drill; its zombie must be reaped and
+            # every straggler killed even on the failure paths.
+            for name, p in procs.items():
+                if p.poll() is None:
+                    p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            for _, fh in logs.values():
+                fh.close()
 
 
 def _check_trace_probe(timeout: int = 300) -> dict:
@@ -1140,6 +1453,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                fault_drill: bool = False, data_bench: bool = False,
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
+               fleet_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
                partition_probe: bool = False, reshape_drill: bool = False,
@@ -1179,6 +1493,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if serve_probe:
         summary["serve_probe"] = _check_serve_probe()
         emit("serve_probe", summary["serve_probe"])
+    if fleet_probe:
+        summary["fleet_probe"] = _check_fleet_probe()
+        emit("fleet_probe", summary["fleet_probe"])
     if trace_probe:
         summary["trace_probe"] = _check_trace_probe()
         emit("trace_probe", summary["trace_probe"])
