@@ -1,0 +1,25 @@
+"""Seeded registry-scope violation: a helper jitting programs directly
+instead of routing them through tpu_resnet/programs/registry.py — the
+bypass pattern the registry-scope lint exists to catch (such a program
+is invisible to the key spelling, the golden engines AND the persistent
+AOT executable cache, so it re-pays cold-start compiles forever)."""
+
+import jax
+from jax.experimental.pjit import pjit
+
+
+def sneaky_speedup(fn):
+    # call-form construction outside the registry scope
+    return jax.jit(fn, static_argnums=(1,))
+
+
+@jax.jit
+def decorated_square(x):
+    # decorator-form construction outside the registry scope
+    return x * x
+
+
+def sharded_apply(fn, in_shardings, out_shardings):
+    # the pjit spelling must be caught too
+    return pjit(fn, in_shardings=in_shardings,
+                out_shardings=out_shardings)

@@ -531,6 +531,28 @@ def test_doctor_check_section():
     assert out["errors"] == 0 and out["stale_baseline"] == 0
 
 
+def test_registry_scope_fixture_flags_direct_jit_construction():
+    """The registry-bypass anti-pattern stays flagged: jax.jit (call
+    and decorator form) and pjit construction outside the
+    registry-owned modules — a program built there is invisible to the
+    key spelling, the golden engines AND the persistent AOT executable
+    cache (tpu_resnet/programs)."""
+    found = fixture_findings("registry_scope_bad", "registry-scope")
+    assert len(found) == 3, found
+    assert {f.line for f in found} == {13, 16, 24}
+    assert all(f.path == "tpu_resnet/analysis/quickcheck.py"
+               for f in found)
+    assert "programs/registry.py" in found[0].message
+    # the registry-owned constructors themselves stay silent
+    from tpu_resnet.analysis.jaxlint import run_jaxlint as _lint
+
+    clean = _lint(REPO, select=["registry-scope"],
+                  files=["tpu_resnet/train/step.py",
+                         "tpu_resnet/serve/infer.py",
+                         "tpu_resnet/programs/registry.py"])
+    assert not clean
+
+
 def test_route_fixture_flags_jax_import_and_handler_teardown():
     """The fleet-router anti-patterns stay flagged: a module-scope jax
     import in the host-isolated router (it must come up on a host whose

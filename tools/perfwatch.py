@@ -72,13 +72,17 @@ METRICS = (
 # Memory metrics invert the verdict: growth past the band is the
 # regression (a knob that "wins" MFU by blowing the HBM budget must not
 # pass silently). Bench records carry hbm_bytes_peak next to mfu
-# (obs/memory.py device stats), sweep points per knob.
+# (obs/memory.py device stats), sweep points per knob. Time-to-ready is
+# the cold-start twin (doctor --coldstart-probe feeds cold/warm serve
+# restart points): a restart getting SLOWER to ready is the regression.
 LOWER_IS_BETTER = {"imagenet_hbm_peak_bytes"}
 SWEEP_MEM_PREFIX = "sweep-mem:"
+SWEEP_TTR_PREFIX = "sweep-ttr:"
 
 
 def _lower_is_better(name: str) -> bool:
-    return name in LOWER_IS_BETTER or name.startswith(SWEEP_MEM_PREFIX)
+    return (name in LOWER_IS_BETTER
+            or name.startswith((SWEEP_MEM_PREFIX, SWEEP_TTR_PREFIX)))
 
 
 def salvage_result(text: str) -> Optional[dict]:
@@ -306,6 +310,17 @@ def load_sweep_samples(paths: List[str]) -> List[dict]:
                     "metric": f"{SWEEP_MEM_PREFIX}{point.get('id')}",
                     "backend": backend,
                     "value": float(mem), "partial": False})
+            # Time-to-ready twin (lower-is-better): the coldstart probe's
+            # cold/warm serve restart points — a warm restart drifting
+            # back toward cold-start times (an executable-cache
+            # regression) gates as regress across probe runs.
+            ttr = point.get("time_to_ready_s")
+            if isinstance(ttr, (int, float)) and ttr > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"{SWEEP_TTR_PREFIX}{point.get('id')}",
+                    "backend": backend,
+                    "value": float(ttr), "partial": False})
     return samples
 
 

@@ -114,6 +114,9 @@ def main(argv=None) -> int:
             print(f"{rule_id:18s} {doc[0] if doc else ''}")
         print("config-matrix      abstract-eval structural checks "
               "(configmatrix.py)")
+        print("registry-coverage  every traced matrix entry resolves "
+              "through programs.spell_entry; one key = one program "
+              "(configmatrix.py)")
         print("golden-jaxpr-drift compiled-program drift vs "
               "golden_jaxprs.json")
         print("golden-memory-drift compiled-program HBM budget drift vs "
@@ -180,7 +183,8 @@ def main(argv=None) -> int:
         # fixed ones still drop out.
         keep = []
         if not full_run:
-            matrix_rules = {"config-matrix", "golden-jaxpr-drift"}
+            matrix_rules = {"config-matrix", "golden-jaxpr-drift",
+                            "registry-coverage"}
             memory_rules = {"golden-memory-drift", "memory-budget"}
             lint_rules = (set(select) if select
                           else set(RULES) | {"parse"})

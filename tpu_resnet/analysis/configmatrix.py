@@ -506,7 +506,8 @@ def verify_matrix(entries: Optional[Tuple[MatrixEntry, ...]] = None,
     findings: List[Finding] = []
     hashes: Dict[str, Tuple[str, str]] = {}
     stats = {"traced": 0, "must_raise": 0, "hash_checked": 0,
-             "lowered": 0, "updated": [], "skipped_lowering": 0}
+             "lowered": 0, "updated": [], "skipped_lowering": 0,
+             "registry_keys": 0}
 
     for entry in entries:
         if progress:
@@ -599,6 +600,47 @@ def verify_matrix(entries: Optional[Tuple[MatrixEntry, ...]] = None,
                     f"({want.get('state_leaves')} -> {len(layout)} "
                     f"leaves) — checkpoints and donation layout are "
                     f"affected; regenerate goldens if intended"))
+
+    # Registry coverage (tpu_resnet/programs): every traced entry must
+    # resolve through the ONE key spelling (programs.spell_entry — the
+    # same function the FLOPs registry, memory ledger and executable
+    # cache key by), and one key must name exactly one program: two
+    # entries that spell the same key with different traced programs
+    # mean the spelling under-specifies a config dimension — the
+    # executable cache would hand one config the other's program (the
+    # PR 1 wrong-executable class, caught here at review time). Two
+    # keys naming one program is fine (identity twins).
+    from tpu_resnet.programs import spell_entry
+
+    key_owners: Dict[str, Tuple[str, Tuple[str, str]]] = {}
+    for entry in entries:
+        if entry.name not in hashes:
+            continue  # must-raise/failed entries never built a program
+        path = f"<config-matrix>/{entry.name}"
+        try:
+            key = spell_entry(entry)
+        except Exception as e:  # noqa: BLE001 - a spell crash is a finding
+            findings.append(Finding(
+                "registry-coverage", path, 0,
+                f"entry does not resolve through the program registry's "
+                f"key spelling (programs.spell_entry raised "
+                f"{type(e).__name__}: {e}) — the check engines and the "
+                f"runtime can no longer agree on what this program is "
+                f"called"))
+            continue
+        stats["registry_keys"] = stats.get("registry_keys", 0) + 1
+        prior = key_owners.get(key)
+        if prior is None:
+            key_owners[key] = (entry.name, hashes[entry.name])
+        elif prior[1] != hashes[entry.name]:
+            findings.append(Finding(
+                "registry-coverage", path, 0,
+                f"program key collision: '{entry.name}' and "
+                f"'{prior[0]}' both spell {key} but trace DIFFERENT "
+                f"programs — the registry key under-specifies a config "
+                f"dimension; extend programs.spell so the executable "
+                f"cache and the flops/memory ledgers can never hand one "
+                f"config the other's program"))
 
     # engine (and any other declared-invariant) twins
     for entry in entries:

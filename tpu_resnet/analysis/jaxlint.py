@@ -41,6 +41,15 @@ host-isolation    The serving fleet's host-side control plane
                   machines with no backend. A module-scope jax/flax/tf
                   import there breaks that contract silently — the same
                   class of rot fork-safety pins for the decode workers.
+registry-scope    Compiled-program construction (``jax.jit``/``pjit``
+                  call sites and decorators) inside the tpu_resnet
+                  package is allowed only in the registry-owned modules
+                  (``REGISTRY_SCOPE_FILES``): every production program
+                  must route through ``programs/registry.py`` so its
+                  key spelling, golden identity, donation contract and
+                  the persistent AOT executable cache all see it. A new
+                  code path jitting directly would silently bypass the
+                  cold-start cache AND the check engines' coverage map.
 guard-parity      Fail-loud guard parity (ADVICE r4): the validation in
                   ``models.build_model`` must also exist in the public
                   constructors (``cifar_resnet_v2``/``imagenet_resnet_v2``)
@@ -88,6 +97,36 @@ JIT_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 FORK_ENTRY_FILES = ("tpu_resnet/data/engine.py",)
 FORK_FORBIDDEN_ROOTS = {"jax", "jaxlib", "flax", "optax", "orbax",
                         "tensorflow", "torch"}
+
+# Modules allowed to construct jitted programs (jax.jit / pjit sites).
+# The registry (programs/registry.py) is the front door; the rest are
+# the canonical constructors it routes — train/step.py (shard_step),
+# data/device_data.py (staged chunk + resident shuffle), data/pipeline.py
+# (the H2D staging take), serve/infer.py + evaluation/evaluator.py (the
+# serving/eval programs), export/serialize.py (the frozen artifact),
+# obs/memory.py + analysis/memorybudget.py (the ledger/golden engines,
+# which deliberately compile the SAME constructors' programs),
+# tools/analysis.py (the info CLI's one-off lowering) and
+# ops/autotune.py (the A/B prober — compiles candidates by design).
+# Scope is the tpu_resnet package: root-level tools/ and bench.py are
+# measurement harnesses outside the production path.
+REGISTRY_SCOPE_FILES = (
+    "tpu_resnet/programs/registry.py",
+    "tpu_resnet/train/step.py",
+    "tpu_resnet/data/device_data.py",
+    "tpu_resnet/data/pipeline.py",
+    "tpu_resnet/serve/infer.py",
+    "tpu_resnet/evaluation/evaluator.py",
+    "tpu_resnet/export/serialize.py",
+    "tpu_resnet/obs/memory.py",
+    "tpu_resnet/analysis/memorybudget.py",
+    "tpu_resnet/tools/analysis.py",
+)
+# The ops/ kernels may jit internally (custom-VJP reference arms, A/B
+# probe candidates, parity helpers): those programs are either inlined
+# into registry-routed traces or exist to be measured against them —
+# kernel-internal, never a run-level dispatch path.
+REGISTRY_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 
 # Host-isolated serving control plane: these modules must import with no
 # accelerator stack present (router on a broken-runtime host; batcher in
@@ -731,6 +770,42 @@ def rule_host_isolation(tree: SourceTree) -> List[Finding]:
     return findings
 
 
+def rule_registry_scope(tree: SourceTree) -> List[Finding]:
+    """jax.jit/pjit construction only in registry-owned modules."""
+    findings = []
+    jit_names = ("jax.jit", "jax.api.jit", "pjit", "jax.pjit",
+                 "jax.experimental.pjit.pjit")
+    for rel, mod in tree.trees.items():
+        if not rel.startswith("tpu_resnet/") \
+                or rel in REGISTRY_SCOPE_FILES \
+                or rel.startswith(REGISTRY_SCOPE_PREFIXES):
+            continue
+        aliases = _alias_map(mod)
+        sites = []
+        for node in ast.walk(mod):
+            if isinstance(node, ast.Call) \
+                    and _resolved(node.func, aliases) in jit_names:
+                sites.append(node.lineno)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _resolved(target, aliases) in jit_names:
+                        sites.append(dec.lineno)
+        for lineno in sorted(set(sites)):
+            findings.append(Finding(
+                "registry-scope", rel, lineno,
+                "direct jax.jit/pjit construction outside the "
+                "registry-owned modules: route the program through "
+                "tpu_resnet/programs/registry.py (or one of the "
+                "canonical constructors in REGISTRY_SCOPE_FILES, "
+                "analysis/jaxlint.py) so its key spelling, golden "
+                "identity, donation contract and the persistent AOT "
+                "executable cache all see it — a bypassed program "
+                "re-pays cold-start XLA compiles on every restart and "
+                "is invisible to `tpu-resnet check` (docs/CHECKS.md)"))
+    return findings
+
+
 def rule_guard_parity(tree: SourceTree) -> List[Finding]:
     """build_model validation mirrored into public constructors (ADVICE r4)."""
     findings = []
@@ -793,6 +868,7 @@ RULES = {
     "fork-safety": rule_fork_safety,
     "signal-safety": rule_signal_safety,
     "host-isolation": rule_host_isolation,
+    "registry-scope": rule_registry_scope,
     "guard-parity": rule_guard_parity,
 }
 

@@ -60,16 +60,15 @@ def compile_entry_budget(entry: MatrixEntry) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh, NamedSharding
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import Mesh
 
     from tpu_resnet.data import augment as aug_lib
-    from tpu_resnet.data.device_data import make_chunk_fn
+    from tpu_resnet.data.device_data import staged_chunk_jit
     from tpu_resnet.models import build_model
     from tpu_resnet.train import schedule as sched_lib
     from tpu_resnet.train.state import init_state
     from tpu_resnet.train.step import (check_step_config, make_train_step,
-                                       per_replica_shard_map, shard_step)
+                                       shard_step)
 
     cfg = entry.to_config()
     check_step_config(cfg, entry.data_axis)
@@ -98,21 +97,13 @@ def compile_entry_budget(entry: MatrixEntry) -> dict:
     imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
     labels = jax.ShapeDtypeStruct((entry.batch,), jnp.int32)
     if entry.builder == "staged-chunk":
-        # Mirror compile_staged_stream_steps exactly (device_data.py):
-        # the fused chunk program the streaming/double-buffered H2D
-        # input edges dispatch, donation on.
-        chunk = make_chunk_fn(base, entry.chunk_steps)
-        if per_replica:
-            chunk = per_replica_shard_map(
-                chunk, mesh,
-                in_specs=(P(), P(None, "data"), P(None, "data"), P()))
-        jitted = jax.jit(
-            chunk,
-            in_shardings=(state_sharding if state_sharding is not None
-                          else NamedSharding(mesh, P()),
-                          NamedSharding(mesh, P(None, "data")),
-                          NamedSharding(mesh, P(None, "data")), None),
-            donate_argnums=(0,))
+        # The fused chunk program the streaming/double-buffered H2D
+        # input edges dispatch, donation on — built by the one canonical
+        # constructor the loop uses (device_data.staged_chunk_jit), so
+        # this engine compiles EXACTLY the runtime's program.
+        jitted = staged_chunk_jit(base, mesh, entry.chunk_steps,
+                                  per_replica_bn=per_replica,
+                                  state_sharding=state_sharding)
         gi = jax.ShapeDtypeStruct(
             (entry.stage_rows, entry.batch, size, size, 3), jnp.uint8)
         gl = jax.ShapeDtypeStruct((entry.stage_rows, entry.batch),

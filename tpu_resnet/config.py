@@ -521,6 +521,31 @@ class RouteConfig:
 
 
 @dataclasses.dataclass
+class ProgramsConfig:
+    """Compiled-program registry (tpu_resnet/programs/registry.py;
+    docs/PERF.md "Cold start"). One owner for the canonical program-key
+    spelling and the persistent cross-process AOT executable cache that
+    kills cold-start compiles across serve-replica restarts, elastic
+    resume, and repeated sweep points."""
+
+    # "auto" (default): the cache is ON for serve replicas (cold start
+    # IS their cost model — the rolling-upgrade window) and ON for
+    # train/eval/sweep only when a cache directory is configured here or
+    # via TPU_RESNET_PROGRAM_CACHE_DIR. "on" forces it everywhere
+    # (directory defaults to <train_dir>/progcache); "off" disables.
+    # The TPU_RESNET_PROGRAM_CACHE=0 env kill-switch overrides all of
+    # this — the operator's hard off-switch when a jaxlib's executable
+    # deserialization is suspect (the PR 1 incident class; the cache
+    # additionally fingerprint-verifies every entry and never
+    # deserializes the same entry twice in one process).
+    cache: str = "auto"  # auto | on | off
+    # "" = <train_dir>/progcache when the cache is enabled. Replicas and
+    # restarts sharing one train_dir share entries; a shared explicit
+    # dir is the sweep/fleet-wide lever.
+    cache_dir: str = ""
+
+
+@dataclasses.dataclass
 class RunConfig:
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
@@ -531,6 +556,8 @@ class RunConfig:
         default_factory=ResilienceConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     route: RouteConfig = dataclasses.field(default_factory=RouteConfig)
+    programs: ProgramsConfig = dataclasses.field(
+        default_factory=ProgramsConfig)
 
     # ---------------------------------------------------------- serialization
     def to_dict(self) -> dict:

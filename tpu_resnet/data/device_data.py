@@ -146,10 +146,39 @@ def make_chunk_fn(base_step: Callable, c: int):
     return chunk
 
 
+def staged_chunk_jit(base_step: Callable, mesh: Mesh, c: int,
+                     per_replica_bn: bool = False,
+                     donate_state: bool = True,
+                     state_sharding=None):
+    """THE jitted fused ``c``-step chunk program over a staged
+    superbatch — the one constructor behind ``compile_staged_stream_steps``
+    (the loop's streaming/double-buffered dispatch), the memory ledger's
+    staged probe (obs/memory.py) and the golden memory-budget engine
+    (analysis/memorybudget.py), so the check engines and the runtime can
+    never compile different programs for the same key
+    (tpu_resnet/programs/registry.py owns the key spelling)."""
+    repl = NamedSharding(mesh, P())
+    staged = NamedSharding(mesh, P(None, "data"))
+    chunk = make_chunk_fn(base_step, c)
+    if per_replica_bn:
+        from tpu_resnet.train.step import per_replica_shard_map
+
+        chunk = per_replica_shard_map(
+            chunk, mesh,
+            in_specs=(P(), P(None, "data"), P(None, "data"), P()))
+    return jax.jit(
+        chunk,
+        in_shardings=(state_sharding if state_sharding is not None
+                      else repl, staged, staged, None),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+
 def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
                                 per_replica_bn: bool = False,
                                 donate_state: bool = True,
-                                state_sharding=None):
+                                state_sharding=None,
+                                program_hook=None):
     """Fused multi-step dispatch for the *streaming* input path — the
     counterpart of ``compile_resident_steps`` for data that arrives as
     staged ``(stage, B, ...)`` superbatches
@@ -171,26 +200,22 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
     ``parallel.StatePartitioner.state_shardings`` (None = fully
     replicated, the historical layout) — the zero1 loop passes its
     sharded tree so the chunk program's optimizer-slot arguments compile
-    to per-shard buffers."""
-    repl = NamedSharding(mesh, P())
-    staged = NamedSharding(mesh, P(None, "data"))
-    state_in = state_sharding if state_sharding is not None else repl
+    to per-shard buffers.
+
+    ``program_hook(c, jitted) -> callable`` lets the program registry
+    (tpu_resnet/programs/registry.py) intercept each per-``c`` jit for
+    its persistent AOT executable cache; None (the default) keeps the
+    exact historical jit objects."""
     cache = {}
 
     def compiled(c: int):
         if c not in cache:
-            chunk = make_chunk_fn(base_step, c)
-            if per_replica_bn:
-                from tpu_resnet.train.step import per_replica_shard_map
-
-                chunk = per_replica_shard_map(
-                    chunk, mesh,
-                    in_specs=(P(), P(None, "data"), P(None, "data"), P()))
-            cache[c] = jax.jit(
-                chunk,
-                in_shardings=(state_in, staged, staged, None),
-                donate_argnums=(0,) if donate_state else (),
-            )
+            jitted = staged_chunk_jit(base_step, mesh, c,
+                                      per_replica_bn=per_replica_bn,
+                                      donate_state=donate_state,
+                                      state_sharding=state_sharding)
+            cache[c] = (program_hook(c, jitted)
+                        if program_hook is not None else jitted)
         return cache[c]
 
     def run(state, gi, gl, off: int, c: int):
@@ -202,7 +227,8 @@ def compile_staged_stream_steps(base_step: Callable, mesh: Mesh,
 def compile_resident_steps(base_step: Callable, ds: DeviceDataset,
                            mesh: Mesh, steps_per_call: int,
                            per_replica_bn: bool = False,
-                           state_sharding=None):
+                           state_sharding=None,
+                           program_hook=None):
     """Returns ``run(state, step, k) -> (state, metrics)`` executing ``k``
     steps (k ≤ steps_per_call) in one dispatch against the resident
     dataset.
@@ -226,7 +252,8 @@ def compile_resident_steps(base_step: Callable, ds: DeviceDataset,
     slices its own local rows."""
     run_staged = compile_staged_stream_steps(base_step, mesh,
                                              per_replica_bn=per_replica_bn,
-                                             state_sharding=state_sharding)
+                                             state_sharding=state_sharding,
+                                             program_hook=program_hook)
 
     def run(state, step: int, k: int):
         """``step`` is the host-tracked step counter (avoids a device sync);

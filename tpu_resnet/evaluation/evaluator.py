@@ -103,20 +103,40 @@ def run_eval_pass(cfg: RunConfig, state, mesh, eval_step_fn
     return correct / max(count, 1), loss_sum / max(count, 1), count
 
 
-def build_eval_step(cfg: RunConfig, mesh, state_sharding=None):
+def build_eval_step(cfg: RunConfig, mesh, state_sharding=None,
+                    registry=None, state_template=None):
     """``state_sharding`` (a TrainState-shaped sharding tree, e.g. from
     the partitioned restore template) lets the eval step accept the
     run's partition layout directly — a zero1 state's sharded optimizer
     slots ride through untouched (eval reads only params/batch_stats,
     which every partition mode keeps replicated). None = the historical
-    fully-replicated signature."""
+    fully-replicated signature.
+
+    ``registry`` (programs.ProgramRegistry) routes the program through
+    the persistent AOT executable cache when enabled — a restarted eval
+    sidecar re-reaches its compiled pass without re-paying XLA.
+    ``state_template`` (the abstract restore template) supplies the
+    state avals the cache path lowers over; both default to the
+    historical plain-jit behavior."""
     model = build_model(cfg)
     _, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
     step = make_eval_step(model, cfg.data.num_classes, eval_pre)
-    return model, jax.jit(step, in_shardings=(
+    jitted = jax.jit(step, in_shardings=(
         state_sharding if state_sharding is not None
         else parallel.replicated(mesh), parallel.batch_sharding(mesh),
         parallel.batch_sharding(mesh)))
+    if registry is not None and registry.cache_enabled \
+            and state_template is not None:
+        gb = _mesh_eval_batch(cfg, mesh)
+        size = cfg.data.resolved_image_size
+        bsh = parallel.batch_sharding(mesh)
+        jitted, _ = registry.wrap(
+            registry.key("eval", batch=gb), jitted,
+            (state_template,
+             jax.ShapeDtypeStruct((gb, size, size, 3), "uint8",
+                                  sharding=bsh),
+             jax.ShapeDtypeStruct((gb,), "int32", sharding=bsh)))
+    return model, jitted
 
 
 def _template_state(cfg: RunConfig, model, mesh):
@@ -149,10 +169,13 @@ def evaluate(cfg: RunConfig, mesh=None, stop_event=None) -> Optional[float]:
     # template, and a zero1 checkpoint restores straight into its
     # optimizer-slot shards. The eval step accepts that same layout.
     template = partitioned_template(cfg, mesh)
+    from tpu_resnet import programs
     model, eval_step_fn = build_eval_step(
         cfg, mesh,
         state_sharding=jax.tree_util.tree_map(lambda s: s.sharding,
-                                              template))
+                                              template),
+        registry=programs.ProgramRegistry(cfg, mesh, context="eval"),
+        state_template=template)
 
     eval_dir = os.path.join(cfg.train.train_dir, "eval")
     metrics = MetricsWriter(eval_dir, enabled=parallel.is_primary())

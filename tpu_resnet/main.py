@@ -137,6 +137,15 @@ def main(argv=None):
                                 "an ephemeral port, fire requests, check "
                                 "/healthz readiness and the SIGTERM "
                                 "drain exit-code contract")
+            p.add_argument("--coldstart-probe", action="store_true",
+                           help="cold-vs-warm serve restart drill "
+                                "(~3min scrubbed CPU): train a small "
+                                "ResNet, serve it cold, SIGTERM, "
+                                "restart warm on the same train_dir — "
+                                "zero XLA compiles on the warm pass "
+                                "(all bucket programs are persistent-"
+                                "cache hits), time-to-ready >= 3x "
+                                "faster, perfwatch ingests both points")
             p.add_argument("--fleet-probe", action="store_true",
                            help="serving-fleet resilience drill (~2min "
                                 "scrubbed CPU): 2 serve replicas + the "
@@ -211,6 +220,7 @@ def main(argv=None):
                              data_bench=args.data_bench,
                              check=args.check,
                              serve_probe=args.serve_probe,
+                             coldstart_probe=args.coldstart_probe,
                              fleet_probe=args.fleet_probe,
                              trace_probe=args.trace_probe,
                              perfwatch=args.perfwatch,

@@ -241,27 +241,15 @@ def account_train_step(cfg, mesh, state, base_step,
     gb = cfg.train.global_batch_size
     img_dtype = "float32" if cfg.data.dataset == "imagenet" else "uint8"
     if stage_rows > 1:
-        # Mirror compile_staged_stream_steps exactly (device_data.py):
-        # the fused chunk program the staged/double-buffered H2D input
-        # edge dispatches per call.
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
+        # The staged/double-buffered input edge's fused chunk program —
+        # built by the ONE canonical constructor the loop itself
+        # dispatches (device_data.staged_chunk_jit), so this ledger entry
+        # can never describe a different program than the run executes.
+        from tpu_resnet.data.device_data import staged_chunk_jit
 
-        from tpu_resnet.data.device_data import make_chunk_fn
-        from tpu_resnet.train.step import per_replica_shard_map
-
-        chunk = make_chunk_fn(base_step, max(1, chunk_steps))
-        if per_replica_bn:
-            chunk = per_replica_shard_map(
-                chunk, mesh,
-                in_specs=(P(), P(None, "data"), P(None, "data"), P()))
-        jitted = jax.jit(
-            chunk,
-            in_shardings=(state_sharding if state_sharding is not None
-                          else NamedSharding(mesh, P()),
-                          NamedSharding(mesh, P(None, "data")),
-                          NamedSharding(mesh, P(None, "data")), None),
-            donate_argnums=(0,))
+        jitted = staged_chunk_jit(base_step, mesh, max(1, chunk_steps),
+                                  per_replica_bn=per_replica_bn,
+                                  state_sharding=state_sharding)
         gi = jax.ShapeDtypeStruct((stage_rows, gb, size, size, 3),
                                   img_dtype)
         gl = jax.ShapeDtypeStruct((stage_rows, gb), "int32")

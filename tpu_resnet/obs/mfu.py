@@ -125,13 +125,14 @@ def mfu(model_flops_per_sec: Optional[float], device_kind: str,
 
 def train_program_key(cfg, mesh_shape: Dict[str, int],
                       kind: str = "train") -> str:
-    """Registry key for the compiled program of ``cfg`` on a mesh —
-    spelled like the config-matrix golden-jaxpr entry names
-    (``cifar10_rn50_bf16`` …, analysis/configmatrix.py) extended with the
-    mesh and batch the FLOPs were counted at:
+    """Registry key for the compiled program of ``cfg`` on a mesh:
 
         train|cifar10_rn50_bf16|mesh1x1|b128
 
+    Pure delegation to :func:`tpu_resnet.programs.spell` — the ONE
+    spelling the FLOPs registry, the memory ledger, the check engines'
+    coverage map and the AOT executable cache all share (one key = one
+    program; key-parity is pinned by tests/test_programs.py).
     ``data.engine`` is deliberately NOT part of the key: thread and
     process engines feed byte-identical programs (the engine-invariance
     twins the verifier pins), so their FLOPs must be one entry.
@@ -140,20 +141,9 @@ def train_program_key(cfg, mesh_shape: Dict[str, int],
     structure), so its space budget must never be read as the
     replicated twin's.
     """
-    m = cfg.model
-    name = m.name if m.name != "resnet" else f"rn{m.resnet_size}"
-    if m.name == "resnet" and m.width_multiplier != 1:
-        name = f"wrn{m.resnet_size}_{m.width_multiplier}"
-    dtype = {"bfloat16": "bf16", "float32": "f32"}.get(
-        m.compute_dtype, m.compute_dtype)
-    partition = getattr(getattr(cfg, "mesh", None), "partition",
-                        "replicated")
-    variant = ("_fused" if m.fused_blocks else "") + \
-              ("_remat" if m.remat else "") + \
-              (f"_{partition}" if partition != "replicated" else "")
-    return (f"{kind}|{cfg.data.dataset}_{name}_{dtype}{variant}"
-            f"|mesh{mesh_shape.get('data', 1)}x{mesh_shape.get('model', 1)}"
-            f"|b{cfg.train.global_batch_size}")
+    from tpu_resnet.programs import spell
+
+    return spell(cfg, mesh_shape, kind=kind)
 
 
 class FlopsRegistry:

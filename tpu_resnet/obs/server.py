@@ -101,6 +101,16 @@ CORE_GAUGES = (
     # the gauge twin of the topology_change span/manifest entry.
     ("topology_changes", "This restart resumed across a mesh/partition "
                          "reshape (resilience/elastic.py)"),
+    # Program registry (tpu_resnet/programs): persistent AOT executable
+    # cache traffic. hits > 0 on a resume/restart means cold-start
+    # compiles were actually skipped; misses on a supposedly-warm
+    # restart are the cache-regression signal doctor --coldstart-probe
+    # gates on.
+    ("compile_cache_hits", "Compiled programs loaded from the "
+                           "persistent AOT executable cache"),
+    ("compile_cache_misses", "Programs compiled because the cache had "
+                             "no trustworthy entry (cold, stale, "
+                             "evicted, or disabled)"),
 )
 
 # Serving-process gauge set (tpu_resnet/serve; docs/SERVING.md). The
@@ -125,6 +135,18 @@ SERVE_GAUGES = (
     ("serve_model_step", "Checkpoint step being served (-1 = frozen "
                          "export bundle)"),
     ("serve_reloads_total", "Checkpoint hot-reloads completed"),
+    # Cold-start observability (tpu_resnet/programs; docs/PERF.md "Cold
+    # start"): how long this replica took to reach ready, how many
+    # bucket programs are warm so far (partial readiness), and the AOT
+    # executable-cache traffic behind those numbers.
+    ("serve_time_to_ready_seconds", "Backend build + restore + bucket "
+                                    "warmup wall time until /healthz ok"),
+    ("serve_buckets_warm", "Bucket programs warmed so far (== bucket "
+                           "count once ready; partial during warmup)"),
+    ("compile_cache_hits", "Bucket programs loaded from the persistent "
+                           "AOT executable cache instead of compiling"),
+    ("compile_cache_misses", "Bucket programs XLA-compiled because the "
+                             "cache had no trustworthy entry"),
 )
 
 # Router gauge set (tpu_resnet/serve/router.py; docs/SERVING.md "Serving
@@ -177,6 +199,11 @@ CORE_HISTOGRAMS = (
     ("train_step_ms", "Per-step wall time, observed once per step at "
                       "each log boundary", LATENCY_BUCKETS_MS),
 )
+# Seconds-scale buckets for once-per-process durations (time-to-ready):
+# sub-second cache-hit restarts through multi-minute cold compiles.
+READY_BUCKETS_S = (0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 60.0, 120.0,
+                   300.0)
+
 SERVE_HISTOGRAMS = (
     ("serve_latency_ms", "End-to-end predict latency (enqueue to "
                          "result)", LATENCY_BUCKETS_MS),
@@ -185,6 +212,10 @@ SERVE_HISTOGRAMS = (
     ("serve_pad_fraction", "Padded fraction of each dispatched bucket "
                            "(compile-avoidance cost per batch)",
      FRACTION_BUCKETS),
+    ("serve_time_to_ready_s", "Time-to-ready per process start (backend "
+                              "build + restore + bucket warmup) — the "
+                              "series the cold-vs-warm restart gate "
+                              "reads", READY_BUCKETS_S),
 )
 ROUTE_HISTOGRAMS = (
     ("route_latency_ms", "End-to-end router latency (accept to client "

@@ -79,8 +79,13 @@ _decisions: Dict[Tuple[str, str], Decision] = {}
 
 
 def shape_key(*dims) -> str:
-    """Canonical shape-key spelling, e.g. ``b128x1000``."""
-    return "x".join(str(int(d)) for d in dims)
+    """Canonical shape-key spelling, e.g. ``b128x1000`` — delegated to
+    ``tpu_resnet.programs.spell_shape`` so the autotune decision table
+    and the program registry can never drift on how a shape is named
+    (key-parity pinned by tests/test_programs.py)."""
+    from tpu_resnet.programs import spell_shape
+
+    return spell_shape(*dims)
 
 
 def decision(op: str, key: str) -> Optional[Decision]:

@@ -1,0 +1,24 @@
+"""Compiled-program registry (docs/PERF.md "Cold start", docs/CHECKS.md).
+
+One owner for the canonical program-key spelling, program construction,
+and the persistent cross-process AOT executable cache. Import stays
+jax-free (jax only inside functions) so stdlib-only consumers — the
+bench parent, perfwatch, doctor — can spell keys and inspect cache
+directories without a backend.
+"""
+
+from tpu_resnet.programs.registry import (CACHE_DIR_ENV, CACHE_KILL_ENV,
+                                          DonationContractError,
+                                          ExecutableCache, ProgramRegistry,
+                                          default_cache_dir,
+                                          fingerprint_lowered, spell,
+                                          spell_entry, spell_shape,
+                                          staged_chunk_hook, state_avals,
+                                          wrap_train_step)
+
+__all__ = [
+    "CACHE_DIR_ENV", "CACHE_KILL_ENV", "DonationContractError",
+    "ExecutableCache", "ProgramRegistry", "default_cache_dir",
+    "fingerprint_lowered", "spell", "spell_entry", "spell_shape",
+    "staged_chunk_hook", "state_avals", "wrap_train_step",
+]

@@ -62,10 +62,20 @@ class ExportBackend:
             return (self.fixed_batch,)
         return tuple(buckets)
 
-    def warmup(self, buckets: Sequence[int]) -> None:
+    def warmup_bucket(self, b: int) -> dict:
+        """Compile/execute one bucket shape. The frozen bundle has no
+        executable cache (the StableHLO artifact IS its ahead-of-time
+        form); ``cache_hit`` is always False here so the per-bucket
+        warmup spans stay comparable across backends."""
+        t0 = time.monotonic()
         s = self.image_size
-        for b in buckets:
-            self._bundle(np.zeros((b, s, s, 3), np.uint8))
+        self._bundle(np.zeros((b, s, s, 3), np.uint8))
+        return {"bucket": int(b), "cache_hit": False,
+                "seconds": round(time.monotonic() - t0, 4)}
+
+    def warmup(self, buckets: Sequence[int]) -> None:
+        for b in sorted(buckets):
+            self.warmup_bucket(b)
 
     def infer(self, images: np.ndarray) -> np.ndarray:
         return self._bundle(images)
@@ -81,7 +91,7 @@ class CheckpointBackend:
     """Live weights from ``cfg.train.train_dir`` with hot-reload."""
 
     def __init__(self, cfg: RunConfig, mesh=None):
-        from tpu_resnet import parallel
+        from tpu_resnet import parallel, programs
         from tpu_resnet.serve.infer import make_serve_infer
         from tpu_resnet.train.checkpoint import (CheckpointManager,
                                                  CheckpointPoller,
@@ -96,6 +106,17 @@ class CheckpointBackend:
         self.reloads = 0
         if mesh is None:
             mesh = parallel.create_mesh(cfg.mesh)
+        # Program registry (tpu_resnet/programs): bucket programs are
+        # built ahead-of-time through the persistent executable cache —
+        # ON by default for serve (programs.cache=auto), because a
+        # replica's cold start IS its cost model: a warm restart against
+        # the same train_dir (the PR 11 rolling-upgrade window) reaches
+        # ready with zero XLA compiles. The per-bucket programs also
+        # survive hot-reloads (weights are ARGUMENTS), exactly like the
+        # jit path they replace.
+        self._registry = programs.ProgramRegistry(cfg, mesh,
+                                                  context="serve")
+        self._compiled = {}  # bucket -> registry program
         # Abstract restore template in the run's partition layout
         # (train.checkpoint.partitioned_template): the checkpoint
         # manager only needs shapes/dtypes/shardings, so no device
@@ -120,15 +141,37 @@ class CheckpointBackend:
         self._swap_lock = threading.Lock()
         self._closed = False
         self._variables = None
+        self._insured = False  # one post-deserialize execution per process
         step = latest_step_in(cfg.train.train_dir)
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint in {cfg.train.train_dir} — train first, "
                 f"or serve a frozen artifact with serve.backend=export")
-        if not self._load(step):
+        # The initial restore runs CONCURRENTLY with bucket warmup:
+        # program construction needs only the abstract template (shapes/
+        # dtypes/shardings — the avals the registry lowers over), so the
+        # orbax read and the XLA compiles/cache loads overlap instead of
+        # serializing. Time-to-ready becomes max(restore, warmup) rather
+        # than their sum; anything that touches the weights
+        # (``infer``, the warmup insurance run) joins first via
+        # ``_ensure_restored`` and surfaces a failed restore with the
+        # same RuntimeError the old synchronous path raised.
+        self._restore_step = step
+        self._restore_thread = threading.Thread(
+            target=self._load, args=(step,),
+            name="tpu-resnet-serve-restore", daemon=True)
+        self._restore_thread.start()
+
+    def _ensure_restored(self) -> None:
+        t = self._restore_thread
+        if t is not None:
+            t.join()
+            self._restore_thread = None
+        if self._variables is None:
             raise RuntimeError(
-                f"checkpoint step {step} in {cfg.train.train_dir} failed "
-                f"to restore after retries")
+                f"checkpoint step {self._restore_step} in "
+                f"{self._cfg.train.train_dir} failed to restore after "
+                f"retries")
 
     def _load(self, step: int) -> bool:
         from tpu_resnet.train.checkpoint import restore_with_retry
@@ -164,20 +207,80 @@ class CheckpointBackend:
     def constrain_buckets(self, buckets: Sequence[int]) -> Tuple[int, ...]:
         return tuple(buckets)
 
-    def warmup(self, buckets: Sequence[int]) -> None:
-        """Compile every bucket shape before readiness. Hot-reloads keep
-        these executables: the swapped pytree has identical
-        structure/shapes, so jit's cache hits — zero mid-traffic
-        recompiles by construction."""
+    def bind_obs(self, telemetry=None, spans=None) -> None:
+        """Late-bind the server's telemetry/span sinks onto the program
+        registry (the backend is constructed before the server owns
+        them): cache hits/misses gauge live, cache loads land on the
+        serve timeline."""
+        if telemetry is not None:
+            self._registry.telemetry = telemetry
+        if spans is not None:
+            self._registry.spans = spans
+
+    def program_cache_stats(self) -> dict:
+        return self._registry.stats()
+
+    def warmup_bucket(self, b: int) -> dict:
+        """Build one bucket program before readiness — through the
+        registry when the cache is enabled (a warm restart loads the
+        serialized executable instead of compiling — ``cache_hit``).
+        The program is constructed from the restore TEMPLATE's avals,
+        so it overlaps the in-flight initial restore.
+
+        A zero-batch execution follows on every compile miss (classic
+        jit-warm semantics) and ONCE per process on the first cache hit
+        — deliberate insurance: an entry that deserialized into
+        something unrunnable dies HERE, behind the 503, never under
+        live traffic. (Wrong-program entries are excluded earlier by
+        the registry's fingerprint check — an execution could not
+        detect those anyway.) Per-bucket repeat runs are skipped on
+        hits: payload hashes already rule out per-entry corruption, and
+        re-running N identical insurance batches was measured to cost
+        more than the cache saves on small models."""
+        import jax
+
+        t0 = time.monotonic()
+        hit = False
+        b = int(b)
         s = self.image_size
-        for b in buckets:
+        if self._registry.cache_enabled and b not in self._compiled:
+            var_avals = {"params": self._template.params,
+                         "batch_stats": self._template.batch_stats}
+            img_aval = jax.ShapeDtypeStruct((b, s, s, 3), "uint8")
+            program, hit = self._registry.wrap(
+                self._registry.key("serve", batch=b), self._infer_fn,
+                (var_avals, img_aval))
+            self._compiled[b] = program
+        if not hit:
             self.infer(np.zeros((b, s, s, 3), np.uint8))
+        elif not self._insured:
+            # Consumed only by a HIT: a compile miss running its own
+            # warmup zeros must not use up the one deserialized-
+            # executable insurance execution this process owes.
+            self._insured = True
+            self.infer(np.zeros((b, s, s, 3), np.uint8))
+        return {"bucket": b, "cache_hit": bool(hit),
+                "seconds": round(time.monotonic() - t0, 4)}
+
+    def warmup(self, buckets: Sequence[int]) -> None:
+        """Compile every bucket shape before readiness, smallest first
+        (cheapest program ready soonest — partial readiness is
+        observable instead of an all-or-nothing wait). Hot-reloads keep
+        these executables: the swapped pytree has identical
+        structure/shapes and the weights are arguments, so every bucket
+        program is reused — zero mid-traffic recompiles by
+        construction."""
+        for b in sorted(buckets):
+            self.warmup_bucket(b)
 
     def infer(self, images: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 
-        return np.asarray(self._infer_fn(self._variables,
-                                         jnp.asarray(images, jnp.uint8)))
+        if self._restore_thread is not None:
+            self._ensure_restored()
+        program = self._compiled.get(images.shape[0], self._infer_fn)
+        return np.asarray(program(self._variables,
+                                  jnp.asarray(images, jnp.uint8)))
 
     def maybe_reload(self) -> bool:
         """Poll for a newer checkpoint and swap it in. Returns True on a

@@ -43,6 +43,15 @@ Checks:
              and verify the graceful drain exits 0. Proves the whole
              serving contract (tpu_resnet/serve; docs/SERVING.md) on
              this machine before a real deployment bets on it.
+  coldstart_probe  optional (--coldstart-probe): cold-vs-warm serve
+             restart drill (tpu_resnet/programs) — train a small
+             ResNet, serve it cold (every bucket program compiles),
+             SIGTERM, restart warm against the same train_dir: the warm
+             pass must perform ZERO XLA compiles (all bucket programs
+             are persistent-cache hits) and reach ready >= 3x faster
+             than cold; both time-to-ready points feed
+             tools/perfwatch.py as a lower-is-better series
+             (docs/PERF.md "Cold start")
   fleet_probe  optional (--fleet-probe): serving-fleet resilience drill
              (tpu_resnet/serve/router.py) — 2 serve replicas + the
              front router on ephemeral ports, 8 clients through the
@@ -420,6 +429,208 @@ def _check_serve_probe(timeout: int = 300) -> dict:
             if proc.poll() is None:
                 proc.kill()
             log_fh.close()
+
+
+def _check_coldstart_probe(timeout: int = 600) -> dict:
+    """Cold-vs-warm serve restart drill (tpu_resnet/programs) in
+    scrubbed CPU subprocesses — the executable-cache acceptance
+    contract on this box:
+
+    1. train a small ResNet (rn50-depth CIFAR head on synthetic data —
+       deep enough that XLA compile, not restore, dominates cold
+       start) and serve it COLD: the per-train_dir program cache is
+       empty, every bucket program compiles
+       (``compile_cache_misses == buckets``), time-to-ready recorded;
+    2. SIGTERM (the PR 11 rolling-upgrade window), then restart WARM
+       against the same train_dir: the warm pass must perform ZERO XLA
+       compiles — ``compile_cache_hits == buckets`` and
+       ``compile_cache_misses == 0`` — and reach ready >= 3x faster
+       than the cold start (the registry's hard perf deliverable);
+    3. both time-to-ready points feed ``tools/perfwatch.py --sweep`` as
+       a lower-is-better series (``sweep-ttr:``), so cache regressions
+       across probe runs are TRACKED, not folklore."""
+    import signal
+    import tempfile
+    import time
+    import urllib.request
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
+    from tpu_resnet.obs.server import parse_prometheus
+
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_coldstart_") as d:
+        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
+                     "--preset", "smoke", f"train.train_dir={d}",
+                     "model.resnet_size=50", "train.train_steps=2",
+                     "train.checkpoint_every=2", "train.log_every=2",
+                     "train.summary_every=2",
+                     "train.image_summary_every=0",
+                     "train.steps_per_call=2",
+                     "train.global_batch_size=4",
+                     "data.device_resident=off", "data.transfer_stage=1"]
+        rc, out = run_scrubbed_subprocess(train_cmd, n_devices=1,
+                                          timeout=timeout)
+        if rc != 0:
+            return {"ok": False, "phase": "train", "rc": rc,
+                    "tail": out.strip().splitlines()[-5:]}
+
+        serve_cmd = [sys.executable, "-m", "tpu_resnet", "serve",
+                     "--preset", "smoke", f"train.train_dir={d}",
+                     "model.resnet_size=50", "data.device_resident=off",
+                     "serve.port=0", "serve.max_batch=16",
+                     "serve.max_wait_ms=5"]
+
+        def one_pass(tag):
+            """(metrics dict | None, drain_rc, tail) for one serve
+            start→ready→SIGTERM cycle."""
+            try:
+                os.remove(os.path.join(d, "serve.json"))
+            except OSError:
+                pass
+            log_path = os.path.join(d, f"serve_{tag}.log")
+            log_fh = open(log_path, "w")
+
+            def tail():
+                log_fh.flush()
+                try:
+                    with open(log_path) as f:
+                        return f.read().strip().splitlines()[-5:]
+                except OSError:
+                    return []
+
+            proc = subprocess.Popen(serve_cmd, env=scrubbed_cpu_env(1),
+                                    stdout=log_fh,
+                                    stderr=subprocess.STDOUT, text=True)
+            try:
+                from tpu_resnet.serve.server import read_serve_port
+
+                base, ready = None, False
+                deadline = time.time() + timeout
+                while time.time() < deadline and proc.poll() is None:
+                    if base is None:
+                        port = read_serve_port(d)
+                        if port is not None:
+                            base = f"http://127.0.0.1:{port}"
+                    if base is not None:
+                        try:
+                            with urllib.request.urlopen(
+                                    base + "/healthz", timeout=2) as r:
+                                if json.loads(r.read()).get("ok"):
+                                    ready = True
+                                    break
+                        except (OSError, ValueError):
+                            pass  # 503 (warming) / not listening yet
+                    time.sleep(0.2)
+                if not ready:
+                    proc.kill()
+                    proc.wait(timeout=10)
+                    return None, proc.returncode, tail()
+                try:
+                    with urllib.request.urlopen(base + "/metrics",
+                                                timeout=10) as r:
+                        metrics = parse_prometheus(r.read().decode())
+                    with urllib.request.urlopen(base + "/info",
+                                                timeout=10) as r:
+                        info = json.loads(r.read())
+                except (OSError, ValueError):
+                    metrics, info = None, {}
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    rc2 = proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    return None, -1, ["server did not exit within 60s "
+                                      "of SIGTERM"]
+                if metrics is None:
+                    return None, rc2, tail()
+                pfx = "tpu_resnet_"
+                return ({"hits": int(metrics.get(
+                             pfx + "compile_cache_hits", -1)),
+                         "misses": int(metrics.get(
+                             pfx + "compile_cache_misses", -1)),
+                         "time_to_ready_s": float(metrics.get(
+                             pfx + "serve_time_to_ready_seconds", 0)),
+                         "buckets": len(info.get("buckets", []))},
+                        rc2, [])
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+                log_fh.close()
+
+        cold, rc_cold, tail_cold = one_pass("cold")
+        if cold is None or rc_cold != 0:
+            return {"ok": False, "phase": "cold_serve", "rc": rc_cold,
+                    "tail": tail_cold}
+        warm, rc_warm, tail_warm = one_pass("warm")
+        if warm is None or rc_warm != 0:
+            return {"ok": False, "phase": "warm_serve", "rc": rc_warm,
+                    "tail": tail_warm}
+
+        result = {"cold": cold, "warm": warm,
+                  "cold_drain_rc": rc_cold, "warm_drain_rc": rc_warm}
+        n = warm["buckets"]
+        if n < 1 or warm["hits"] != n or warm["misses"] != 0:
+            result.update(ok=False, phase="warm_zero_compiles",
+                          error=f"warm restart must be all cache hits: "
+                                f"expected hits=={n} misses==0, got "
+                                f"hits={warm['hits']} "
+                                f"misses={warm['misses']}")
+            return result
+        if cold["misses"] != n or cold["hits"] != 0:
+            result.update(ok=False, phase="cold_all_compiles",
+                          error=f"cold start should compile every "
+                                f"bucket (hits=0, misses={n}), got "
+                                f"{cold} — was the cache dir not "
+                                f"fresh?")
+            return result
+        ratio = (cold["time_to_ready_s"] / warm["time_to_ready_s"]
+                 if warm["time_to_ready_s"] else 0.0)
+        result["ttr_ratio"] = round(ratio, 2)
+        if ratio < 3.0:
+            result.update(ok=False, phase="time_to_ready",
+                          error=f"warm restart must reach ready >= 3x "
+                                f"faster than cold, got {ratio:.2f}x "
+                                f"(cold {cold['time_to_ready_s']:.2f}s "
+                                f"vs warm "
+                                f"{warm['time_to_ready_s']:.2f}s)")
+            return result
+
+        # perfwatch ingestion: cold/warm time-to-ready as a sweep-style
+        # trajectory judged lower-is-better (sweep-ttr:) — a cache
+        # regression across probe runs becomes a tracked regress.
+        # Skipped on an installed wheel without tools/.
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        script = os.path.join(root, "tools", "perfwatch.py")
+        if os.path.exists(script):
+            traj = {"metric": "coldstart_ttr", "backend": "cpu",
+                    "points": [
+                        {"id": f"coldstart={name}", "status": "ok",
+                         "backend": "cpu", "steps_per_sec": 1.0,
+                         "time_to_ready_s": m["time_to_ready_s"]}
+                        for name, m in (("cold", cold), ("warm", warm))]}
+            traj_path = os.path.join(d, "coldstart_probe_sweep.json")
+            with open(traj_path, "w") as f:
+                json.dump(traj, f)
+            try:
+                pw = subprocess.run(
+                    [sys.executable, script, "--sweep", traj_path],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True, timeout=60)
+            except subprocess.TimeoutExpired:
+                result.update(ok=False, perfwatch="hung")
+                return result
+            ingested = all(f"sweep-ttr:coldstart={n}" in pw.stdout
+                           for n in ("cold", "warm"))
+            result["perfwatch_ingested"] = ingested
+            if pw.returncode != 0 or not ingested:
+                result.update(ok=False, phase="perfwatch",
+                              perfwatch_tail=pw.stdout.strip()
+                              .splitlines()[-5:])
+                return result
+        else:
+            result["perfwatch_ingested"] = "skipped (no tools/perfwatch.py)"
+        result["ok"] = True
+        return result
 
 
 def _check_fleet_probe(timeout: int = 420) -> dict:
@@ -1453,6 +1664,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                fault_drill: bool = False, data_bench: bool = False,
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
+               coldstart_probe: bool = False,
                fleet_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
@@ -1493,6 +1705,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if serve_probe:
         summary["serve_probe"] = _check_serve_probe()
         emit("serve_probe", summary["serve_probe"])
+    if coldstart_probe:
+        summary["coldstart_probe"] = _check_coldstart_probe()
+        emit("coldstart_probe", summary["coldstart_probe"])
     if fleet_probe:
         summary["fleet_probe"] = _check_fleet_probe()
         emit("fleet_probe", summary["fleet_probe"])

@@ -458,6 +458,14 @@ def run_sweep(points: List[Dict], args, runner=None,
             if flags:
                 child_env["XLA_FLAGS"] = (
                     (child_env.get("XLA_FLAGS", "") + " " + flags).strip())
+            if getattr(args, "program_cache", ""):
+                # Shared persistent AOT executable cache
+                # (tpu_resnet/programs): resumed sweeps and repeated
+                # points stop re-paying XLA compilation — the child's
+                # sweep_measure registry picks the directory up from
+                # the environment.
+                child_env["TPU_RESNET_PROGRAM_CACHE_DIR"] = \
+                    args.program_cache
             timeout = args.point_timeout
             if hard_deadline is not None:
                 timeout = max(30, min(timeout,
@@ -572,6 +580,12 @@ def main(argv=None) -> int:
     ap.add_argument("--dtype", default="float32")
     ap.add_argument("--batch", type=int, default=128,
                     help="base batch when the space has no batch knob")
+    ap.add_argument("--program-cache", default="",
+                    help="shared persistent AOT executable cache dir "
+                         "(tpu_resnet/programs) exported to every child "
+                         "as TPU_RESNET_PROGRAM_CACHE_DIR — repeated "
+                         "and resumed sweep points skip XLA recompiles "
+                         "of programs an earlier child already built")
     args = ap.parse_args(argv)
 
     if args.point:

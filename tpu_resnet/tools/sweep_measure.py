@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from tpu_resnet import parallel
+from tpu_resnet import parallel, programs
 from tpu_resnet.data import device_data
 from tpu_resnet.models import build_model
 from tpu_resnet.train import schedule as sched_lib
@@ -33,6 +33,13 @@ def build_point_programs(cfg, mesh, donate_state: bool = True):
     ``cfg.mesh.partition`` (the sweep's ``partition`` knob) selects the
     state layout through the same ``parallel.StatePartitioner`` the loop
     asks.
+
+    Programs route through ``programs.ProgramRegistry``: with a shared
+    cache directory (``TPU_RESNET_PROGRAM_CACHE_DIR``, which
+    ``tools/sweep.py --program-cache`` exports to every child) repeated
+    sweep points and resumed sweeps stop re-paying XLA compilation for
+    programs an earlier child already compiled; without one the
+    registry is an identity pass-through.
 
     Returns ``(state, step_fn, run_staged)``.
     """
@@ -54,9 +61,23 @@ def build_point_programs(cfg, mesh, donate_state: bool = True):
                            partitioner=partitioner)
     state_sharding = (partitioner.state_shardings(state)
                       if partitioner.is_sharded else None)
+    prog_reg = programs.ProgramRegistry(cfg, mesh, context="sweep")
     step_fn = shard_step(base, mesh, donate_state=donate_state,
                          state_sharding=state_sharding)
+    hook = None
+    if prog_reg.cache_enabled:
+        # The SAME aval/key constructors the train loop uses
+        # (programs.wrap_train_step / staged_chunk_hook): a sweep child
+        # and the loop can never cache different programs under
+        # drifting keys.
+        avals = programs.state_avals(state)
+        step_fn = programs.wrap_train_step(prog_reg, step_fn, avals,
+                                           donate_state=donate_state)
+        hook = programs.staged_chunk_hook(
+            prog_reg, avals, max(1, cfg.data.transfer_stage),
+            donate_state=donate_state)
+
     run_staged = device_data.compile_staged_stream_steps(
         base, mesh, donate_state=donate_state,
-        state_sharding=state_sharding)
+        state_sharding=state_sharding, program_hook=hook)
     return state, step_fn, run_staged

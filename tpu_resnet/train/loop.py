@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_resnet import obs, parallel, resilience
+from tpu_resnet import obs, parallel, programs, resilience
 from tpu_resnet.config import RunConfig
 from tpu_resnet.data import augment as aug_lib
 from tpu_resnet.data import device_data
@@ -342,6 +342,18 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
         # passes None and keeps the exact historical program.
         state_sharding = (partitioner.state_shardings(state)
                           if partitioner.is_sharded else None)
+        # Program registry (tpu_resnet/programs): every program this
+        # loop dispatches is constructed through it — identity
+        # pass-through (the exact historical jit objects) unless the
+        # persistent AOT executable cache is enabled
+        # (programs.cache/cache_dir or TPU_RESNET_PROGRAM_CACHE_DIR —
+        # the elastic-resume cold-start lever), in which case each
+        # program is AOT-compiled over its real avals and round-tripped
+        # through <cache_dir>, so a resumed process re-reaches its
+        # topology's programs without re-paying XLA.
+        prog_reg = programs.ProgramRegistry(cfg, mesh, telemetry=telemetry,
+                                            spans=spans, context="train")
+        state_avals = programs.state_avals(state)
         if parallel.is_primary() and ops.autotune.decisions():
             # The run's dispatch choices as a reviewable artifact.
             ops.autotune.dump(cfg.train.train_dir)
@@ -364,7 +376,11 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             run_chunk = device_data.compile_resident_steps(
                 base_step, ds, mesh, max(1, cfg.train.steps_per_call),
                 per_replica_bn=per_replica_bn,
-                state_sharding=state_sharding)
+                state_sharding=state_sharding,
+                program_hook=(programs.staged_chunk_hook(
+                                  prog_reg, state_avals,
+                                  ds.steps_per_epoch)
+                              if prog_reg.cache_enabled else None))
             data_iter = None
         else:
             data_iter, stage, host_iter = build_train_iterator(
@@ -373,11 +389,17 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
             if stage > 1:
                 run_staged = device_data.compile_staged_stream_steps(
                     base_step, mesh, per_replica_bn=per_replica_bn,
-                    state_sharding=state_sharding)
+                    state_sharding=state_sharding,
+                    program_hook=(programs.staged_chunk_hook(
+                                      prog_reg, state_avals, stage)
+                                  if prog_reg.cache_enabled else None))
             else:
                 train_step = shard_step(base_step, mesh,
                                         per_replica_bn=per_replica_bn,
                                         state_sharding=state_sharding)
+                if prog_reg.cache_enabled:
+                    train_step = programs.wrap_train_step(
+                        prog_reg, train_step, state_avals)
 
         meter = ThroughputMeter(cfg.train.global_batch_size,
                                 num_chips=mesh.size)
