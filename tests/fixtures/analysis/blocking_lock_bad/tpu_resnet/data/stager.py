@@ -1,0 +1,44 @@
+"""Seeded blocking-under-lock fixture: queue get/put, event wait,
+thread join, sleep and file/network I/O inside ``with lock:`` bodies —
+every other acquirer of the lock waits on the blocked operation (the
+PR 5 drain-hang shape)."""
+
+import queue
+import threading
+import time
+import urllib.request
+
+
+class Stager:
+    def __init__(self, it):
+        self._it = it
+        self._q = queue.Queue(maxsize=2)
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.staged = 0
+        self._thread = threading.Thread(target=self._fill, daemon=True)
+        self._thread.start()
+
+    def _fill(self):
+        for item in self._it:
+            with self._lock:
+                # BUG: blocking put while holding the stats lock — the
+                # consumer needs the same lock to drain.
+                self._q.put(item)
+                self.staged += 1
+        self._done.set()
+
+    def take(self):
+        with self._lock:
+            # BUG: blocking get under the lock the producer needs.
+            return self._q.get()
+
+    def flush(self, path, url):
+        with self._lock:
+            # BUG: sleep / event wait / join / file / network under lock.
+            time.sleep(0.5)
+            self._done.wait()
+            self._thread.join()
+            with open(path, "w") as f:
+                f.write(str(self.staged))
+            urllib.request.urlopen(url)

@@ -1,0 +1,52 @@
+"""PRE-FIX PR 5 admission race (seeded fixture — this is the bug shape
+review caught by hand: the engine must catch it mechanically).
+
+``submit`` (HTTP handler threads) checks the accepting flag bare and
+puts; ``drain`` (main thread) flips the flag bare and flushes only what
+it can see. A submit racing the flip lands its request AFTER the final
+flush and the client hangs for the full wait timeout instead of getting
+an immediate 503. The fixed code serializes both sides under an
+admission lock (tpu_resnet/serve/batcher.py ``_admit_lock``).
+"""
+
+import queue
+import threading
+
+
+class Draining(Exception):
+    pass
+
+
+class MicroBatcher:
+    def __init__(self, infer_fn):
+        self._infer = infer_fn
+        self._queue = queue.Queue(maxsize=16)
+        self._accepting = True
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, images):
+        # BUG: bare check-then-put — the drain flip can interleave here.
+        if not self._accepting:
+            raise Draining("server is draining")
+        self._queue.put_nowait(images)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._infer(item)
+
+    def drain(self):
+        # BUG: unlocked flag flip racing submit's unlocked check.
+        self._accepting = False
+        self._stop.set()
+        self._thread.join(timeout=5)
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break

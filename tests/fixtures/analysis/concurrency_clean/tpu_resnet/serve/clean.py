@@ -1,0 +1,109 @@
+"""False-positive suite: four threaded patterns that are CORRECT and
+must produce zero concurrency findings — the exemption logic is as much
+of the contract as the rules.
+
+- queue-channel: threads communicate only through Queue/Event objects
+  (their methods ARE the synchronization).
+- immutable-after-start: configuration written in ``__init__`` only,
+  read freely from every context.
+- lock-free single-writer ring: one thread writes the cursor, nothing
+  else touches it.
+- atomic publish: every write guarded, the hot-path read bare (the
+  serve backend's ``_variables`` idiom).
+"""
+
+import queue
+import threading
+
+
+class QueueChannel:
+    """Threads exchange work through channels only."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._tasks = queue.Queue()
+        self._results = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        self._tasks.put(item)
+
+    def take(self):
+        return self._results.get()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self._tasks.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            self._results.put(self._fn(item))
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class ImmutableAfterStart:
+    """Config assigned before the thread starts, then only read."""
+
+    def __init__(self, interval, sink):
+        self.interval = float(interval)
+        self._sink = sink
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self._sink(self.interval)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class SingleWriterRing:
+    """Only the producer thread moves the write cursor."""
+
+    def __init__(self, slots):
+        self._slots = [None] * slots
+        self._head = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+
+    def _produce(self):
+        while not self._stop.is_set():
+            self._slots[self._head % len(self._slots)] = object()
+            self._head += 1
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class AtomicPublish:
+    """Writes serialized under the lock; the hot-path read is a single
+    reference load (the documented lock-free consumer)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._lock = threading.Lock()
+        self._value = None
+        self._thread = threading.Thread(target=self._reload, daemon=True)
+        self._thread.start()
+
+    def _reload(self):
+        v = self._loader()
+        with self._lock:
+            self._value = v
+
+    def get(self):
+        return self._value
+
+    def refresh(self):
+        with self._lock:
+            self._value = self._loader()

@@ -1,0 +1,50 @@
+"""PRE-FIX PR 11 hedge attribution (seeded fixture).
+
+The hedged send spawns daemon legs that write the router's breaker
+bookkeeping (failure counter, last_error) directly from the leg
+threads, while ``route_predict`` writes the same fields on its own
+thread — a failed hedge leg double-charges (or mis-charges) the primary
+replica's breaker and opens a healthy replica's circuit. The fixed code
+attributes every leg's result exactly once through the results queue
+and charges inside one owner (_attempt), under the router lock.
+"""
+
+import queue
+import threading
+
+
+class Router:
+    def __init__(self, forward):
+        self._forward = forward
+        self._lock = threading.Lock()
+        self.replica_errors = 0
+        self.last_error = None
+
+    def _attempt(self, replica, body):
+        results = queue.Queue()
+
+        def call(rep, who):
+            try:
+                results.put((who, self._forward(rep, body)))
+            except OSError as e:
+                # BUG: breaker bookkeeping written from the hedge-leg
+                # thread, racing route_predict's own writes.
+                self.replica_errors += 1
+                self.last_error = str(e)
+                results.put((who, e))
+
+        threading.Thread(target=call, args=(replica, "primary"),
+                         daemon=True).start()
+        threading.Thread(target=call, args=(replica, "hedge"),
+                         daemon=True).start()
+        return results.get(timeout=1.0)
+
+    def route_predict(self, replica, body):
+        try:
+            who, res = self._attempt(replica, body)
+        except OSError as e:
+            # BUG: same fields, another thread, no lock — double charge.
+            self.replica_errors += 1
+            self.last_error = str(e)
+            return None
+        return res

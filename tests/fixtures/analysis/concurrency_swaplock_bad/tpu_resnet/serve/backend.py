@@ -1,0 +1,44 @@
+"""PRE-FIX PR 11 swap lock (seeded fixture).
+
+The hot-reload restore thread swaps ``_variables`` bare while
+``maybe_reload`` swaps it under the lock (the guard discipline drifted
+between the two sites), and ``close()`` tears the checkpoint manager
+down while the daemon restore thread may still be mid-restore — the
+drain-during-reload window the real backend closes with ``_swap_lock``
+held on BOTH sides.
+"""
+
+import threading
+
+
+class CheckpointBackend:
+    def __init__(self, ckpt, template):
+        self._ckpt = ckpt
+        self._template = template
+        self._swap_lock = threading.Lock()
+        self._variables = None
+        self._closed = False
+        self._restore_thread = threading.Thread(
+            target=self._load, args=(0,), daemon=True)
+        self._restore_thread.start()
+
+    def _load(self, step):
+        state = self._ckpt.restore(self._template, step)
+        # BUG: the restore thread publishes the swap bare while
+        # maybe_reload's path publishes under the swap lock.
+        self._variables = {"params": state.params}
+
+    def maybe_reload(self, step):
+        with self._swap_lock:
+            state = self._ckpt.restore(self._template, step)
+            self._variables = {"params": state.params}
+
+    def infer(self, images):
+        return self._variables, images
+
+    def close(self):
+        # BUG: frees the manager the daemon restore thread is using —
+        # no join, no stop event, no common lock (the real close() holds
+        # _swap_lock, and _load aborts under it when closed).
+        self._closed = True
+        self._ckpt.release()

@@ -1,0 +1,83 @@
+"""Seeded lock-order fixtures: the ABBA deadlock (two methods taking
+the same two locks in opposite orders) and the non-reentrant
+self-deadlock (a Lock re-acquired on a path that already holds it,
+directly and through a method call)."""
+
+import threading
+
+
+class FleetState:
+    def __init__(self):
+        self._replica_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.replicas = {}
+        self.stats = {}
+
+    def admit(self, name):
+        # Order: replica -> stats
+        with self._replica_lock:
+            self.replicas[name] = True
+            with self._stats_lock:
+                self.stats[name] = 0
+
+    def report(self):
+        # BUG: order stats -> replica — deadlocks against admit().
+        with self._stats_lock:
+            out = dict(self.stats)
+            with self._replica_lock:
+                out["replicas"] = len(self.replicas)
+        return out
+
+
+class Member:
+    def __init__(self):
+        self._member_lock = threading.Lock()
+        self.load = 0
+        self.fleet = None
+
+    def rebalance(self):
+        # Order: member -> fleet (via the fleet's locked method).
+        with self._member_lock:
+            self.load = 0
+            self.fleet.note_admit("self")
+
+
+class FleetView:
+    """BUG (cross-class ABBA): holds the fleet-view lock while calling
+    into Member, whose rebalance() holds ITS lock while calling back
+    into a fleet-view-locked method — two objects, opposite orders."""
+
+    def __init__(self, member):
+        self._view_lock = threading.Lock()
+        self.member = member
+        self.totals = {}
+
+    def note_admit(self, name):
+        with self._view_lock:
+            self.totals[name] = 1
+
+    def refresh(self):
+        # Order: fleet-view -> member.
+        with self._view_lock:
+            self.member.rebalance()
+
+
+class Reacquirer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def _bump(self):
+        with self._lock:
+            self.n += 1
+
+    def bump_twice(self):
+        # BUG: non-reentrant Lock re-acquired through a call while held.
+        with self._lock:
+            self._bump()
+
+    def bump_nested(self):
+        # BUG: direct lexical re-acquisition — immediate self-deadlock.
+        with self._lock:
+            with self._lock:
+                self.n += 1

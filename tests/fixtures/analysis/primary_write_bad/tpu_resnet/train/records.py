@@ -1,0 +1,21 @@
+"""Seeded primary-only-write fixture: a module writing the shared
+train_dir artifacts directly instead of through the canonical atomic,
+primary-only helpers (obs/manifest.write_manifest,
+resilience/elastic.write_topology) — on a shared train_dir, N processes
+race these writes into torn records."""
+
+import json
+import os
+
+
+def note_topology(train_dir, mesh_shape):
+    # BUG: bypasses elastic.write_topology (primary gate + tmp+replace).
+    with open(os.path.join(train_dir, "topology.json"), "w") as f:
+        json.dump({"mesh_shape": mesh_shape}, f)
+
+
+def note_manifest(train_dir, cfg):
+    # BUG: bypasses obs/manifest.write_manifest.
+    path = os.path.join(train_dir, "manifest.json")
+    with open(path, "w") as f:
+        json.dump({"config": cfg}, f)

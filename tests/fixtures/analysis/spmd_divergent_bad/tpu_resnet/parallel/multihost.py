@@ -1,0 +1,39 @@
+"""Seeded SPMD-divergence fixture — the classic pod deadlock shapes,
+planted in the module the multi-host on-ramp owns (parallel/multihost
+joins the lint scope; ROADMAP item 1).
+
+Every gated call here runs on SOME processes only: the others never
+enter the collective / never build the program, and the pod hangs at
+the next synchronization point instead of raising anywhere.
+"""
+
+import jax
+
+from tpu_resnet.programs import registry
+from tpu_resnet.train.step import make_train_step
+
+
+def is_primary():
+    return jax.process_index() == 0
+
+
+def build_programs(fn, avals, state):
+    if jax.process_index() == 0:
+        # BUG: only process 0 compiles — everyone else diverges at the
+        # first dispatch.
+        step = jax.jit(fn)
+    else:
+        step = fn
+    if is_primary():
+        # BUG: registry dispatch gated on primary.
+        program, _ = registry.wrap("train", fn, avals)
+        step_fn = make_train_step(fn, avals)
+        _ = (program, step_fn)
+    return step(state)
+
+
+def sync_metrics(metrics, process_id):
+    if process_id == 0:
+        # BUG: a collective only the primary enters — all-host hang.
+        return jax.lax.psum(metrics, "data")
+    return metrics

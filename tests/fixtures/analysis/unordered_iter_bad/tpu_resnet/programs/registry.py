@@ -1,0 +1,28 @@
+"""Seeded unordered-iteration fixture: set order and directory-scan
+order feeding program construction / key spelling — two processes can
+enumerate these differently (PYTHONHASHSEED, filesystem) and build or
+name programs in diverging orders."""
+
+import glob
+import os
+
+import jax
+
+
+def warm_buckets(fn, buckets):
+    programs = {}
+    # BUG: set order varies across processes.
+    for b in set(buckets):
+        programs[b] = jax.jit(fn)
+    return programs
+
+
+def spell_all(entries):
+    # BUG: set comprehension feeding the key spelling.
+    return [f"train|{name}" for name in {e.name for e in entries}]
+
+
+def cache_entries(cache_dir):
+    # BUG: glob order is filesystem-dependent.
+    return [os.path.basename(p)
+            for p in glob.glob(os.path.join(cache_dir, "*.bin"))]

@@ -355,6 +355,13 @@ def test_matrix_covers_required_combinations():
     assert {"cifar10", "cifar100", "synthetic", "imagenet"} <= datasets
     assert {e.dtype for e in MATRIX} >= {"float32", "bfloat16"}
     assert any(e.data_axis > 1 for e in MATRIX)
+    # 2-D ("batch","model") pod shapes (ROADMAP item 1 pre-work):
+    # replicated AND zero1 rows exist and the zero1 one is lowered on
+    # the concrete 8-device mesh.
+    two_d = [e for e in MATRIX if e.model_axis > 1 and not e.expect_error]
+    assert len(two_d) >= 3
+    assert any(e.partition == "zero1" and e.check_lowering
+               for e in two_d)
     assert any(e.fused for e in MATRIX) and any(e.remat for e in MATRIX)
     assert any(e.engine == "process" for e in MATRIX)
     assert sum(1 for e in MATRIX if e.expect_error) >= 3
@@ -442,17 +449,23 @@ def test_engine_twin_mismatch_detected():
 
 
 def test_repo_is_clean():
-    """THE tier-1 gate: lints + full config matrix over the repo, clean
-    with the checked-in (empty) baseline and goldens."""
+    """THE tier-1 gate: lints + concurrency + spmd + full config matrix
+    over the repo, clean with the checked-in (empty) baseline and
+    goldens."""
+    from tpu_resnet.analysis import run_concurrency, run_spmd
+
     findings = run_jaxlint(REPO)
+    findings += run_concurrency(REPO)
+    findings += run_spmd(REPO)
     matrix_findings, stats = configmatrix.verify_matrix()
     findings += [f for f in matrix_findings if f.severity == "error"]
     assert findings == [], "\n".join(f.format() for f in findings)
     assert stats["traced"] >= 21 and stats["must_raise"] >= 3
     assert stats["hash_checked"] == stats["traced"]
     # donation/sharding contract lowered on the concrete 8-dev mesh
-    # (mesh8 sync-BN + per-replica + the zero1 sharded-slot layout)
-    assert stats["lowered"] == 3
+    # (mesh8 sync-BN + per-replica + the zero1 sharded-slot layout +
+    # the 2-D mesh4x2 zero1 pod shape)
+    assert stats["lowered"] == 4
 
 
 # -------------------------------------------------------------- CLI/doctor
@@ -529,6 +542,8 @@ def test_doctor_check_section():
     out = doctor._check_static_analysis(matrix=False)
     assert out["ok"] is True, out
     assert out["errors"] == 0 and out["stale_baseline"] == 0
+    # the doctor child runs engine 4 too (concurrency + spmd)
+    assert {"lint", "concurrency", "spmd"} <= set(out["engines"]), out
 
 
 def test_registry_scope_fixture_flags_direct_jit_construction():

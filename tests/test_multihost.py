@@ -198,6 +198,94 @@ def _run_two_process(script, tmp_path):
     return [json.loads(o.strip().splitlines()[-1]) for o in outs]
 
 
+# ----------------------------------------------------- fast unit tier
+# parallel/multihost.py joins the SPMD-lint scope this PR; its env
+# protocol gets direct unit coverage (the two-process integration tests
+# below stay slow-tier).
+def _clear_tpu_env(monkeypatch):
+    for var in ("TPU_COORDINATOR_ADDRESS", "TPU_NUM_PROCESSES",
+                "TPU_PROCESS_ID", "TPU_PROCS_PER_NODE",
+                "TPU_LOCAL_RANK", "TPU_CHIPS_PER_NODE"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_initialize_single_process_is_noop(monkeypatch):
+    """No coordinator configured → the serial branch: never calls
+    jax.distributed.initialize (the reference's serial path analog)."""
+    import jax
+
+    from tpu_resnet.parallel import multihost
+
+    _clear_tpu_env(monkeypatch)
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    multihost.initialize()
+    assert calls == []
+
+
+def test_initialize_env_resolution_order(monkeypatch):
+    """Explicit args beat the TPU_* launcher env vars, which beat
+    auto-detection — the documented resolution order."""
+    import jax
+
+    from tpu_resnet.parallel import multihost
+
+    _clear_tpu_env(monkeypatch)
+    monkeypatch.setenv("TPU_COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setenv("TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("TPU_PROCESS_ID", "3")
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    multihost.initialize()
+    assert calls[-1]["coordinator_address"] == "10.0.0.1:8476"
+    assert calls[-1]["num_processes"] == 4
+    assert calls[-1]["process_id"] == 3
+    # explicit args override the env protocol
+    multihost.initialize(coordinator_address="127.0.0.1:9",
+                         num_processes=2, process_id=1)
+    assert calls[-1]["coordinator_address"] == "127.0.0.1:9"
+    assert calls[-1]["num_processes"] == 2
+    assert calls[-1]["process_id"] == 1
+
+
+def test_initialize_multi_proc_per_node_device_slices(monkeypatch):
+    """TPU_PROCS_PER_NODE > 1: each colocated process claims a disjoint
+    chip slice from its node-local rank; an over-subscribed node raises
+    the named ValueError."""
+    import jax
+
+    from tpu_resnet.parallel import multihost
+
+    _clear_tpu_env(monkeypatch)
+    monkeypatch.setenv("TPU_COORDINATOR_ADDRESS", "127.0.0.1:9")
+    monkeypatch.setenv("TPU_NUM_PROCESSES", "4")
+    monkeypatch.setenv("TPU_PROCESS_ID", "1")
+    monkeypatch.setenv("TPU_PROCS_PER_NODE", "2")
+    monkeypatch.setenv("TPU_LOCAL_RANK", "1")
+    monkeypatch.setenv("TPU_CHIPS_PER_NODE", "4")
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    multihost.initialize()
+    assert calls[-1]["local_device_ids"] == [2, 3]
+    monkeypatch.setenv("TPU_PROCS_PER_NODE", "8")
+    with pytest.raises(ValueError, match="TPU_PROCS_PER_NODE"):
+        multihost.initialize()
+
+
+def test_is_primary_is_process_index_zero(monkeypatch):
+    import jax
+
+    from tpu_resnet.parallel import multihost
+
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert multihost.is_primary() is True
+    monkeypatch.setattr(jax, "process_index", lambda: 2)
+    assert multihost.is_primary() is False
+
+
 @pytest.mark.slow
 def test_two_process_data_parallel(tmp_path):
     results = _run_two_process(WORKER, tmp_path)

@@ -605,6 +605,11 @@ def test_export_serve_parity(tmp_path):
     export_from_checkpoint(cfg, cfg.serve.export_dir)
 
     live = CheckpointBackend(cfg)
+    # The initial restore runs on a background thread (overlapped with
+    # warmup by design); join it before touching _variables directly —
+    # reading the published reference without the join is exactly the
+    # race the concurrency engine flags in production code.
+    live._ensure_restored()
     frozen = ExportBackend(cfg.serve.export_dir)
     bundle = load_inference(cfg.serve.export_dir)  # tools/predict's path
 

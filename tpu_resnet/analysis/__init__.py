@@ -1,6 +1,6 @@
 """Static-analysis suite — ``python -m tpu_resnet check``.
 
-Two engines over one Finding model (docs/CHECKS.md):
+Four engines over one Finding model (docs/CHECKS.md):
 
 ``jaxlint``       AST lints for the repo's JAX/TPU contracts (host-sync
                   hazards under jit, static-arg hygiene, fork-safe worker
@@ -9,12 +9,24 @@ Two engines over one Finding model (docs/CHECKS.md):
 ``configmatrix``  abstract-eval verifier: traces the real train/eval
                   steps for every supported config combination on an
                   abstract mesh and checks dtype discipline, donation
-                  layout, sharding contracts and golden jaxpr hashes.
+                  layout, sharding contracts and golden jaxpr hashes
+                  (the golden memory budgets ride on the same entries).
+``concurrency``   thread/lock race detector: per-class thread-context
+                  graphs over every threaded module (batcher, router,
+                  data engine, watchdog, pollers) with unguarded-write /
+                  guard-consistency / lock-order / blocking-under-lock /
+                  daemon-teardown rules. Pure ``ast``.
+``spmd``          SPMD-divergence lint for the multi-host on-ramp:
+                  process-identity-gated dispatch/collectives, shared
+                  train_dir artifact writer discipline, unordered
+                  iteration feeding program construction. Pure ``ast``.
 
 Import note: keep this ``__init__`` lazy-free and jax-free so the
 lint-only CLI path stays sub-second.
 """
 
+from tpu_resnet.analysis.concurrency import (CONCURRENCY_RULES,
+                                             run_concurrency)
 from tpu_resnet.analysis.findings import (
     Finding,
     apply_baseline,
@@ -24,14 +36,19 @@ from tpu_resnet.analysis.findings import (
     save_baseline,
 )
 from tpu_resnet.analysis.jaxlint import RULES, run_jaxlint
+from tpu_resnet.analysis.spmd import SPMD_RULES, run_spmd
 
 __all__ = [
+    "CONCURRENCY_RULES",
     "Finding",
     "RULES",
+    "SPMD_RULES",
     "apply_baseline",
     "apply_pragmas",
     "load_baseline",
     "render_report",
+    "run_concurrency",
     "run_jaxlint",
+    "run_spmd",
     "save_baseline",
 ]

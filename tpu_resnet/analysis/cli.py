@@ -1,10 +1,14 @@
 """CLI for the static-analysis suite.
 
-    python -m tpu_resnet check                 # lints + config matrix
+    python -m tpu_resnet check                 # lints + concurrency +
+                                               #   spmd + config matrix
                                                #   + golden memory budgets
-    python -m tpu_resnet check --skip-matrix   # lints only (<1s, no jax)
+    python -m tpu_resnet check --skip-matrix   # AST engines only
+                                               #   (seconds, no jax)
     python -m tpu_resnet check --skip-memory   # skip the XLA-compile-
                                                #   backed memory engine
+    python -m tpu_resnet check --skip-concurrency --skip-spmd
+                                               # PR-4-era engine set
     python -m tpu_resnet check --update-golden # intentional regeneration
                                                #   (jaxprs AND memory)
     tpu-resnet-check                           # console-script alias
@@ -20,9 +24,12 @@ import json
 import os
 import sys
 
+from tpu_resnet.analysis.concurrency import (CONCURRENCY_RULES,
+                                             run_concurrency)
 from tpu_resnet.analysis.findings import (apply_baseline, load_baseline,
                                           render_report, save_baseline)
 from tpu_resnet.analysis.jaxlint import RULES, run_jaxlint
+from tpu_resnet.analysis.spmd import SPMD_RULES, run_spmd
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
 
@@ -74,11 +81,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="repo root to lint (default: the checkout "
                         "containing the tpu_resnet package)")
     p.add_argument("--rules", default="",
-                   help=f"comma-separated lint rule subset of "
-                        f"{sorted(RULES)}")
+                   help=f"comma-separated AST-rule subset of "
+                        f"{sorted(RULES) + sorted(CONCURRENCY_RULES) + sorted(SPMD_RULES)}")
     p.add_argument("--skip-lint", action="store_true")
+    p.add_argument("--skip-concurrency", action="store_true",
+                   help="skip the thread/lock race-detector engine "
+                        "(analysis/concurrency.py)")
+    p.add_argument("--skip-spmd", action="store_true",
+                   help="skip the SPMD-divergence lint "
+                        "(analysis/spmd.py)")
     p.add_argument("--skip-matrix", action="store_true",
-                   help="lint only — never imports jax, runs <1s "
+                   help="AST engines only (lint + concurrency + spmd) "
+                        "— never imports jax, seconds not minutes "
                         "(also skips the memory-budget engine, which "
                         "rides on the matrix entries)")
     p.add_argument("--skip-memory", action="store_true",
@@ -109,9 +123,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        for rule_id, fn in sorted(RULES.items()):
-            doc = (fn.__doc__ or "").strip().splitlines()
-            print(f"{rule_id:18s} {doc[0] if doc else ''}")
+        for rules in (RULES, CONCURRENCY_RULES, SPMD_RULES):
+            for rule_id, fn in sorted(rules.items()):
+                doc = (fn.__doc__ or "").strip().splitlines()
+                print(f"{rule_id:18s} {doc[0] if doc else ''}")
         print("config-matrix      abstract-eval structural checks "
               "(configmatrix.py)")
         print("registry-coverage  every traced matrix entry resolves "
@@ -128,17 +143,54 @@ def main(argv=None) -> int:
     root = args.root or _default_root()
     files = None if args.root else _default_files(root)
     select = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    if select:
+        known = set(RULES) | set(CONCURRENCY_RULES) | set(SPMD_RULES)
+        unknown = set(select) - known
+        if unknown:
+            print(f"unknown rule(s) {sorted(unknown)}; "
+                  f"have {sorted(known)}", file=sys.stderr)
+            return 2
     # Partial runs (--skip-*/--rules) see only a subset of findings:
     # they can neither judge baseline entries stale nor rewrite the
     # baseline wholesale without deleting the other engines' entries.
     full_run = not (args.skip_lint or args.skip_matrix
-                    or args.skip_memory or select)
+                    or args.skip_memory or args.skip_concurrency
+                    or args.skip_spmd or select)
+
+    def _subset(rules):
+        """--rules subset owned by one AST engine (None = all of it;
+        empty list = the engine has nothing selected and is skipped)."""
+        if select is None:
+            return None
+        return [r for r in select if r in rules]
 
     findings = []
     checked = []
-    if not args.skip_lint:
-        findings += run_jaxlint(root, select=select, files=files)
+    # One parsed SourceTree shared by the three AST engines: the
+    # "<2s, no jax" path must not read+parse every file three times.
+    # Each engine also surfaces tree.parse_errors (an unparseable file
+    # must never count as clean just because lint was skipped); the
+    # dedup below collapses the copies when several engines run.
+    ast_tree = None
+    if not (args.skip_lint and args.skip_concurrency and args.skip_spmd):
+        from tpu_resnet.analysis.jaxlint import SourceTree
+
+        ast_tree = SourceTree(root, files=files)
+    lint_select = _subset(RULES)
+    if not args.skip_lint and lint_select != []:
+        findings += run_jaxlint(root, select=lint_select, tree=ast_tree)
         checked.append("lint")
+    conc_select = _subset(CONCURRENCY_RULES)
+    if not args.skip_concurrency and conc_select != []:
+        findings += run_concurrency(root, select=conc_select,
+                                    tree=ast_tree)
+        checked.append("concurrency")
+    spmd_select = _subset(SPMD_RULES)
+    if not args.skip_spmd and spmd_select != []:
+        findings += run_spmd(root, select=spmd_select, tree=ast_tree)
+        checked.append("spmd")
+    findings = list({(f.rule, f.path, f.line, f.message): f
+                     for f in findings}.values())
     stats = {}
     if not args.skip_matrix:
         _prepare_jax_env()
@@ -186,15 +238,22 @@ def main(argv=None) -> int:
             matrix_rules = {"config-matrix", "golden-jaxpr-drift",
                             "registry-coverage"}
             memory_rules = {"golden-memory-drift", "memory-budget"}
-            lint_rules = (set(select) if select
-                          else set(RULES) | {"parse"})
+            selected = set(select) if select else None
 
             def ran(rule: str) -> bool:
                 if rule in matrix_rules:
                     return not args.skip_matrix
                 if rule in memory_rules:
                     return not (args.skip_matrix or args.skip_memory)
-                return not args.skip_lint and rule in lint_rules
+                if rule in CONCURRENCY_RULES:
+                    return (not args.skip_concurrency
+                            and (selected is None or rule in selected))
+                if rule in SPMD_RULES:
+                    return (not args.skip_spmd
+                            and (selected is None or rule in selected))
+                lint_rules = set(RULES) | {"parse"}
+                return (not args.skip_lint and rule in lint_rules
+                        and (selected is None or rule in selected))
 
             keep = [e for e in load_baseline(args.baseline)
                     if not ran(e.get("rule", ""))]
@@ -220,7 +279,8 @@ def main(argv=None) -> int:
         payload = json.dumps(
             {"findings": [f.to_dict() for f in new],
              "suppressed": [f.to_dict() for f in suppressed],
-             "stale_baseline": stale, "matrix": stats}, indent=1)
+             "stale_baseline": stale, "matrix": stats,
+             "engines": checked}, indent=1)
         if args.json_out == "-":
             print(payload)
         else:

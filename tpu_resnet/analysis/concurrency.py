@@ -1,0 +1,917 @@
+"""`concurrency` — thread/lock race detector, engine 4a of `tpu-resnet check`.
+
+Ten modules in this repo spawn threads (micro-batcher, router, prober,
+DoubleBufferedH2D, data-engine workers, restore thread, watchdog,
+checkpoint poller consumers, telemetry HTTP servers) and their races
+have historically been caught only by manual review passes: the PR 5
+admission race (a submit racing the drain flip hung its client for the
+full wait timeout), the PR 11 hedge attribution bugs (breaker
+bookkeeping charged from racing hedge-leg threads), the PR 11 swap-lock
+gap (close() tearing the checkpoint manager down under a mid-flight
+hot-reload restore). This engine encodes the discipline those fixes
+established as checkable rules, ThreadSanitizer-style but static.
+
+Model — the **thread-context graph**, built per class:
+
+- *entry points*: methods (or nested functions) handed to
+  ``threading.Thread(target=…)`` / ``threading.Timer`` /
+  ``ThreadPoolExecutor.submit``, or referenced in a Thread's ``args``;
+  ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses (each HTTP
+  request runs on its own server thread); handlers registered via
+  ``signal.signal``.
+- *contexts*: each thread entry is one context; each public method is a
+  caller context of its own (a class that spawns threads is, by
+  construction, driven from more than one thread — the batcher's
+  ``submit`` runs on HTTP handler threads while ``drain`` runs on the
+  main thread); ``__init__`` is the construction context
+  (happens-before every thread start, so its writes are exempt);
+  signal handlers interleave with — but never run in parallel to — the
+  main thread, so they form a non-concurrent context.
+- *shared state*: ``self.*`` attribute accesses, with the lexical
+  ``with self._lock:`` guard stack tracked per access. Only attribute
+  REBINDS count as writes (item assignment into a dict/list under the
+  GIL is atomic; rebind + check-then-act is where the races were).
+
+Rules (each with a seeded fixture in tests/fixtures/analysis/):
+
+unguarded-shared-write   an attribute with an unguarded non-init write
+                         that another concurrent context also touches
+                         unguarded. Exemptions prove the model honest:
+                         channel attributes (``queue.Queue``, ``Event``,
+                         locks — their methods are the synchronization),
+                         immutable-after-start attributes (written only
+                         in ``__init__``), and the atomic-publish
+                         pattern (ALL writes guarded → a bare read of
+                         the reference is the documented lock-free
+                         consumer, e.g. the serve backend's
+                         ``_variables``).
+inconsistent-guard       the same attribute written under a lock at one
+                         site and bare at another — the discipline
+                         drifted; one of the two sites is wrong.
+lock-order-cycle         the lock-acquisition graph (lexical nesting +
+                         one level of intra-class/module calls) has a
+                         cycle — the classic ABBA deadlock — or a
+                         non-reentrant ``Lock`` is re-acquired on a path
+                         that already holds it.
+blocking-under-lock      ``join``/queue ``get``/``put``/event ``wait``/
+                         ``time.sleep``/socket/urlopen/subprocess inside
+                         a ``with lock:`` body — every other acquirer of
+                         that lock now waits on the blocked operation
+                         (the shape of the PR 5 drain hang).
+daemon-shared-teardown   a ``close()``-like method frees state (rebinds
+                         it to None or ``.close()``/``.unlink()``s it)
+                         that a daemon-thread context still uses, without
+                         stopping that thread first (no join/stop-event/
+                         shutdown in the method) and without the
+                         lock-serialized teardown idiom the serve
+                         backend's ``_swap_lock`` established.
+
+Pure ``ast`` — never imports jax; rides the same Finding/pragma/baseline
+machinery as jaxlint, so the lint-only CLI stays sub-second.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tpu_resnet.analysis.findings import Finding, apply_pragmas
+from tpu_resnet.analysis.jaxlint import (SourceTree, _alias_map, _dotted,
+                                         _resolved)
+
+# Types whose construction marks an attribute as a lock (guard), a
+# channel (synchronization object — exempt shared state), or a thread
+# handle. Resolved through the file's import aliases.
+LOCK_TYPES = {"threading.Lock", "threading.RLock", "multiprocessing.Lock"}
+RLOCK_TYPES = {"threading.RLock"}
+CONDITION_TYPES = {"threading.Condition"}
+CHANNEL_TYPES = {
+    "queue.Queue", "queue.PriorityQueue", "queue.LifoQueue",
+    "queue.SimpleQueue", "collections.deque",
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "threading.Condition",
+    "multiprocessing.Event", "multiprocessing.Queue",
+}
+THREAD_TYPES = {"threading.Thread", "threading.Timer",
+                "multiprocessing.Process"}
+
+# Channel construction via a spawn context (``ctx.Event()``/
+# ``ctx.Queue()``): matched on the method name when the receiver is not
+# an import-resolvable module.
+_CHANNEL_CTX_METHODS = {"Queue", "Event", "Value", "JoinableQueue"}
+
+PUBLIC_DUNDERS = {"__next__", "__iter__", "__call__", "__enter__",
+                  "__exit__", "__del__"}
+TEARDOWN_NAMES = {"close", "shutdown", "stop", "unlink", "drain",
+                  "__exit__", "__del__"}
+# Calls that count as "this teardown stops its threads first".
+_STOP_MARKERS = {"join", "shutdown", "terminate", "cancel", "set",
+                 "server_close", "kill"}
+_FREE_CALL_METHODS = {"close", "unlink", "server_close", "release"}
+
+# blocking-under-lock deny sets.
+_BLOCKING_EXACT = {
+    "time.sleep": "host sleep",
+    "open": "file open (disk/NFS latency)",
+    "urllib.request.urlopen": "network request",
+    "socket.create_connection": "network connect",
+    "subprocess.run": "child process wait",
+    "subprocess.check_output": "child process wait",
+    "subprocess.check_call": "child process wait",
+    "subprocess.Popen": "child process spawn",
+    "os.system": "child process wait",
+}
+_QUEUE_BLOCKING_METHODS = {"get", "put", "join"}
+_EVENT_BLOCKING_METHODS = {"wait"}
+_THREAD_BLOCKING_METHODS = {"join"}
+
+# Context kinds. "init" and "signal" never run in parallel with the
+# others ("init" happens-before thread start; CPython delivers signals
+# on the main thread between bytecodes).
+_NONCONCURRENT = ("init", "signal")
+
+
+def _is_concurrent_pair(a: str, b: str) -> bool:
+    if a == b:
+        return False
+    if a in _NONCONCURRENT or b in _NONCONCURRENT:
+        return False
+    return True
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    kind: str                  # "write" | "read"
+    line: int
+    guards: frozenset          # lock attr names lexically held
+    func: str                  # defining function key
+    wrote_none: bool = False   # write whose value is (or contains) None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One method or method-nested function of a class."""
+
+    key: str                   # "method" or "method.nested"
+    node: ast.AST
+    calls: Set[str] = dataclasses.field(default_factory=set)
+    contexts: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    # (lock, line) acquisitions and, per acquisition, what runs inside.
+    acquires: List[Tuple[str, int]] = dataclasses.field(
+        default_factory=list)
+    # (held-lock, acquired-lock, line): a With acquiring `acquired`
+    # while `held` was already on the guard stack — the lock-order
+    # rule's edge events, recorded in the one _walk_func pass.
+    acquire_edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list)
+    # (Call node, held locks) for every call made with >= 1 lock held —
+    # consumed by blocking-under-lock and the lock-order callee
+    # propagation, so neither rule re-implements the guard-stack walk.
+    guarded_calls: List[Tuple[ast.Call, Tuple[str, ...]]] = \
+        dataclasses.field(default_factory=list)
+
+
+def _call_name(node: ast.Call, aliases) -> Optional[str]:
+    return _resolved(node.func, aliases)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for ``self.x``; None for deeper chains or other receivers."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _assign_targets(node) -> List[Tuple[str, bool]]:
+    """(self-attr, value-is/contains-None) pairs rebound by an
+    assignment statement, matching tuple targets positionally."""
+    out: List[Tuple[str, bool]] = []
+
+    def value_is_none(v) -> bool:
+        return isinstance(v, ast.Constant) and v.value is None
+
+    if isinstance(node, ast.Assign):
+        values = node.value
+        for target in node.targets:
+            if isinstance(target, ast.Tuple) and \
+                    isinstance(values, ast.Tuple) and \
+                    len(target.elts) == len(values.elts):
+                for t, v in zip(target.elts, values.elts):
+                    a = _self_attr(t)
+                    if a:
+                        out.append((a, value_is_none(v)))
+            else:
+                for t in ast.walk(target):
+                    a = _self_attr(t)
+                    if a and isinstance(t.ctx, ast.Store):
+                        out.append((a, value_is_none(values)))
+    elif isinstance(node, ast.AugAssign):
+        a = _self_attr(node.target)
+        if a:
+            out.append((a, False))
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        a = _self_attr(node.target)
+        if a:
+            out.append((a, value_is_none(node.value)))
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            a = _self_attr(t)
+            if a:
+                out.append((a, True))
+    return out
+
+
+class ClassModel:
+    """Thread-context graph + shared-state map for one class."""
+
+    def __init__(self, rel: str, cls: ast.ClassDef, aliases: Dict[str, str],
+                 module_locks: Set[str]):
+        self.rel = rel
+        self.cls = cls
+        self.aliases = aliases
+        self.module_locks = module_locks
+        self.lock_attrs: Set[str] = set()
+        self.plain_lock_attrs: Set[str] = set()   # non-reentrant Lock()
+        self.channel_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        self.funcs: Dict[str, FuncInfo] = {}
+        # entry key -> daemon?
+        self.thread_entries: Dict[str, bool] = {}
+        self.signal_handlers: Set[str] = set()
+        self.is_http_handler = any(
+            _dotted(b) in ("BaseHTTPRequestHandler",
+                           "http.server.BaseHTTPRequestHandler")
+            or (isinstance(b, ast.Attribute)
+                and b.attr == "BaseHTTPRequestHandler")
+            for b in cls.bases)
+        self._collect_funcs()
+        self._classify_attrs()
+        self._find_entries()
+        self._assign_contexts()
+        self._collect_accesses()
+
+    # ------------------------------------------------------------- structure
+    def _collect_funcs(self) -> None:
+        for node in self.cls.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self.funcs[node.name] = FuncInfo(node.name, node)
+            for sub in ast.walk(node):
+                if sub is node or not isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                self.funcs[f"{node.name}.{sub.name}"] = FuncInfo(
+                    f"{node.name}.{sub.name}", sub)
+        # intra-class call edges: self.m() and bare calls to sibling
+        # nested functions.
+        for key, info in self.funcs.items():
+            method = key.split(".")[0]
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                a = _self_attr(call.func)
+                if a and a in self.funcs:
+                    info.calls.add(a)
+                elif isinstance(call.func, ast.Name):
+                    nested = f"{method}.{call.func.id}"
+                    if nested in self.funcs:
+                        info.calls.add(nested)
+
+    def _classify_attrs(self) -> None:
+        for node in ast.walk(self.cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            attrs = [a for t in node.targets
+                     for a in [_self_attr(t)] if a]
+            if not attrs or not isinstance(node.value, ast.Call):
+                continue
+            resolved = _call_name(node.value, self.aliases) or ""
+            method = (node.value.func.attr
+                      if isinstance(node.value.func, ast.Attribute) else "")
+            for a in attrs:
+                if resolved in LOCK_TYPES or resolved in CONDITION_TYPES:
+                    self.lock_attrs.add(a)
+                    if resolved not in RLOCK_TYPES:
+                        self.plain_lock_attrs.add(a)
+                if resolved in CHANNEL_TYPES or \
+                        method in _CHANNEL_CTX_METHODS:
+                    self.channel_attrs.add(a)
+                if resolved in THREAD_TYPES:
+                    self.thread_attrs.add(a)
+        # Condition/locks are also channels in the exemption sense.
+        self.channel_attrs |= self.lock_attrs
+
+    def _thread_call_entries(self, call: ast.Call, method: str,
+                             ) -> List[str]:
+        """Entry keys referenced by one Thread/Timer/submit call."""
+        out: List[str] = []
+
+        def entry_for(expr) -> Optional[str]:
+            a = _self_attr(expr)
+            if a and a in self.funcs:
+                return a
+            if isinstance(expr, ast.Name):
+                nested = f"{method}.{expr.id}"
+                if nested in self.funcs:
+                    return nested
+            return None
+
+        resolved = _call_name(call, self.aliases) or ""
+        is_submit = (isinstance(call.func, ast.Attribute)
+                     and call.func.attr == "submit")
+        if resolved in THREAD_TYPES:
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    e = entry_for(kw.value)
+                    if e:
+                        out.append(e)
+                elif kw.arg == "args" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)):
+                    # nested callbacks handed INTO a worker (e.g. a
+                    # counter-add closure) execute on that thread too.
+                    for elt in kw.value.elts:
+                        e = entry_for(elt)
+                        if e:
+                            out.append(e)
+            if resolved == "threading.Timer" and len(call.args) >= 2:
+                e = entry_for(call.args[1])
+                if e:
+                    out.append(e)
+        elif is_submit and call.args:
+            e = entry_for(call.args[0])
+            if e:
+                out.append(e)
+        return out
+
+    def _find_entries(self) -> None:
+        for key, info in self.funcs.items():
+            method = key.split(".")[0]
+            for call in ast.walk(info.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                resolved = _call_name(call, self.aliases) or ""
+                for entry in self._thread_call_entries(call, method):
+                    daemon = any(
+                        kw.arg == "daemon"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in call.keywords)
+                    self.thread_entries[entry] = (
+                        self.thread_entries.get(entry, False) or daemon)
+                if resolved == "signal.signal" and len(call.args) == 2:
+                    a = _self_attr(call.args[1])
+                    if a and a in self.funcs:
+                        self.signal_handlers.add(a)
+        # ``t.daemon = True`` on a stored thread attr marks every entry
+        # of this class daemon (conservative; one-thread classes are the
+        # norm here).
+        for node in ast.walk(self.cls):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                        for e in self.thread_entries:
+                            self.thread_entries[e] = True
+
+    @property
+    def analyzed(self) -> bool:
+        """Shared-state rules run only on classes that demonstrably run
+        in more than one context: they spawn threads or serve HTTP."""
+        return bool(self.thread_entries) or self.is_http_handler
+
+    def _reach(self, roots: Iterable[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(roots)
+        while stack:
+            key = stack.pop()
+            if key in seen or key not in self.funcs:
+                continue
+            seen.add(key)
+            stack.extend(self.funcs[key].calls)
+        return seen
+
+    def _assign_contexts(self) -> None:
+        for entry, _ in self.thread_entries.items():
+            for key in self._reach([entry]):
+                self.funcs[key].contexts.add(f"thread:{entry}")
+        for handler in self.signal_handlers:
+            for key in self._reach([handler]):
+                self.funcs[key].contexts.add("signal")
+        if "__init__" in self.funcs:
+            for key in self._reach(["__init__"]):
+                self.funcs[key].contexts.add("init")
+        for key, info in self.funcs.items():
+            if "." in key:
+                continue
+            public = (not key.startswith("_")) or key in PUBLIC_DUNDERS
+            if self.is_http_handler and key.startswith("do_"):
+                for k in self._reach([key]):
+                    self.funcs[k].contexts.add(f"handler:{key}")
+            elif public and key != "__init__" and \
+                    key not in self.thread_entries:
+                for k in self._reach([key]):
+                    self.funcs[k].contexts.add(f"caller:{key}")
+        # A method-nested function with no context of its own (a callback
+        # not handed to a thread) runs wherever its definer runs.
+        for key, info in self.funcs.items():
+            if "." in key and not info.contexts:
+                definer = key.split(".")[0]
+                info.contexts |= self.funcs[definer].contexts
+        for info in self.funcs.values():
+            if not info.contexts:
+                # private, never called intra-class: reachable only from
+                # outside (a callback wired to another object) — its own
+                # caller context.
+                info.contexts.add(f"caller:{info.key}")
+
+    # --------------------------------------------------------------- access
+    def _guard_name(self, item: ast.AST) -> Optional[str]:
+        a = _self_attr(item)
+        if a and a in self.lock_attrs:
+            return a
+        if isinstance(item, ast.Name) and item.id in self.module_locks:
+            return f"<module>.{item.id}"
+        return None
+
+    def _collect_accesses(self) -> None:
+        for key, info in self.funcs.items():
+            self._walk_func(info)
+
+    def _walk_func(self, info: FuncInfo) -> None:
+        own = info.node
+
+        def process(node: ast.AST, guards: Tuple[str, ...]) -> None:
+            """Node-first traversal: each node is classified ITSELF
+            before recursion, so arbitrarily nested ``with`` statements
+            extend the guard stack correctly."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not own:
+                return  # deferred execution: separate FuncInfo
+            if isinstance(node, ast.With):
+                names = [self._guard_name(item.context_expr)
+                         for item in node.items]
+                acquired = tuple(n for n in names if n)
+                for n in acquired:
+                    info.acquires.append((n, node.lineno))
+                    for h in guards:
+                        if h != n:
+                            info.acquire_edges.append((h, n, node.lineno))
+                    if n in guards:
+                        info.accesses.append(Access(
+                            n, "reacquire", node.lineno,
+                            frozenset(guards), info.key))
+                for item in node.items:
+                    process(item.context_expr, guards)
+                held = guards + acquired
+                for stmt in node.body:
+                    process(stmt, held)
+                return
+            if isinstance(node, ast.Call) and guards:
+                info.guarded_calls.append((node, guards))
+            self._scan_stmt(info, node, guards)
+            for child in ast.iter_child_nodes(node):
+                process(child, guards)
+
+        for stmt in own.body:
+            process(stmt, ())
+
+    def _scan_stmt(self, info: FuncInfo, node: ast.AST,
+                   guards: Tuple[str, ...]) -> None:
+        """Record the accesses introduced by ONE node (non-recursive for
+        writes — assignment statements; recursive walks happen in
+        ``visit`` which calls this per child)."""
+        g = frozenset(guards)
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                             ast.Delete)):
+            for attr, none in _assign_targets(node):
+                info.accesses.append(Access(attr, "write", node.lineno, g,
+                                            info.key, wrote_none=none))
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            a = _self_attr(node)
+            if a:
+                info.accesses.append(Access(a, "read", node.lineno, g,
+                                            info.key))
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _FREE_CALL_METHODS:
+            a = _self_attr(node.func.value)
+            if a:
+                info.accesses.append(Access(a, "free", node.lineno, g,
+                                            info.key))
+
+
+# ------------------------------------------------------------------- rules
+def _iter_classes(tree: SourceTree) -> List[Tuple[str, ClassModel]]:
+    """ClassModels for every package class — built ONCE per SourceTree
+    and memoized on it: five rules share the models (the context/access
+    walk is ~4x the cost of the rules themselves), the same way the CLI
+    shares one parsed tree across the three AST engines."""
+    cached = getattr(tree, "_concurrency_models", None)
+    if cached is not None:
+        return cached
+    models: List[Tuple[str, ClassModel]] = []
+    for rel, mod in tree.trees.items():
+        if not rel.startswith("tpu_resnet/"):
+            continue
+        aliases = _alias_map(mod)
+        module_locks = {
+            t.id
+            for node in mod.body if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and (_call_name(node.value, aliases) or "") in LOCK_TYPES
+            for t in node.targets if isinstance(t, ast.Name)}
+        for node in ast.walk(mod):
+            if isinstance(node, ast.ClassDef):
+                models.append((rel, ClassModel(rel, node, aliases,
+                                               module_locks)))
+    tree._concurrency_models = models
+    return models
+
+
+def _attr_sites(model: ClassModel):
+    """attr -> list of (Access, contexts) across the class."""
+    out: Dict[str, List[Tuple[Access, Set[str]]]] = {}
+    for info in model.funcs.values():
+        for acc in info.accesses:
+            out.setdefault(acc.attr, []).append((acc, info.contexts))
+    return out
+
+
+def rule_unguarded_shared_write(tree: SourceTree) -> List[Finding]:
+    """multi-context attr with an unguarded write and no consistent lock."""
+    findings = []
+    for rel, model in _iter_classes(tree):
+        if not model.analyzed:
+            continue
+        for attr, sites in sorted(_attr_sites(model).items()):
+            if attr in model.channel_attrs:
+                continue
+            writes = [(a, c) for a, c in sites if a.kind == "write"]
+            noninit_writes = [(a, c) for a, c in writes
+                              if c - {"init", "signal"}]
+            if not noninit_writes:
+                continue  # immutable-after-start (or signal-flag only)
+            unguarded_writes = [(a, c) for a, c in noninit_writes
+                                if not a.guards]
+            if not unguarded_writes:
+                continue  # consistently guarded; bare reads are the
+                #           atomic-publish pattern (documented exempt)
+            # evidence: an unguarded access in a context concurrent with
+            # some unguarded write's context.
+            unguarded_accesses = [(a, c) for a, c in sites if not a.guards]
+            per_context: Dict[str, List[Access]] = {}
+            for acc, ctxs in unguarded_writes:
+                hit = False
+                for other, octxs in unguarded_accesses:
+                    if other is acc:
+                        continue
+                    if other.func == acc.func and not any(
+                            c.startswith(("thread:", "handler:"))
+                            for c in ctxs | octxs):
+                        # One function reachable from several public
+                        # roots races only with itself — assumed
+                        # serialized unless it actually runs on a
+                        # thread/handler context.
+                        continue
+                    if any(_is_concurrent_pair(x, y)
+                           for x in ctxs - set(_NONCONCURRENT)
+                           for y in octxs - set(_NONCONCURRENT)):
+                        hit = True
+                        break
+                if hit:
+                    ctx_key = ",".join(sorted(ctxs - {"init"})) or "caller"
+                    per_context.setdefault(ctx_key, []).append(acc)
+            for ctx_key, accs in sorted(per_context.items()):
+                first = min(accs, key=lambda a: a.line)
+                others = sorted({
+                    f"{a.func}:{a.line}" for a, c in sites
+                    if a is not first and not a.guards})[:4]
+                findings.append(Finding(
+                    "unguarded-shared-write", rel, first.line,
+                    f"'{model.cls.name}.{attr}' is written without a lock "
+                    f"in context [{ctx_key}] "
+                    f"({first.func}:{first.line}) while other concurrent "
+                    f"contexts touch it unguarded (e.g. "
+                    f"{', '.join(others) if others else 'elsewhere'}) — "
+                    f"hold one consistent lock at every site, publish "
+                    f"through a queue/Event channel, or make the "
+                    f"attribute immutable after __init__ "
+                    f"(docs/CHECKS.md concurrency)"))
+    return findings
+
+
+def rule_inconsistent_guard(tree: SourceTree) -> List[Finding]:
+    """attr written under a lock at one site and bare at another."""
+    findings = []
+    for rel, model in _iter_classes(tree):
+        if not model.analyzed:
+            continue
+        for attr, sites in sorted(_attr_sites(model).items()):
+            if attr in model.channel_attrs:
+                continue
+            noninit_writes = [a for a, c in sites if a.kind == "write"
+                              and c - {"init", "signal"}]
+            guarded = [a for a in noninit_writes if a.guards]
+            bare = [a for a in noninit_writes if not a.guards]
+            if not guarded or not bare:
+                continue
+            locks = sorted({lk for a in guarded for lk in a.guards})
+            first = min(bare, key=lambda a: a.line)
+            findings.append(Finding(
+                "inconsistent-guard", rel, first.line,
+                f"'{model.cls.name}.{attr}' is written under "
+                f"{'/'.join(locks)} at "
+                f"{', '.join(sorted(f'{a.func}:{a.line}' for a in guarded))} "
+                f"but bare at "
+                f"{', '.join(sorted(f'{a.func}:{a.line}' for a in bare))} "
+                f"— one of the two disciplines is wrong; guard every "
+                f"write site with the same lock"))
+    return findings
+
+
+def rule_lock_order_cycle(tree: SourceTree) -> List[Finding]:
+    """acquisition-graph cycles (ABBA deadlock) + Lock re-acquisition.
+
+    The graph spans CLASSES within a module: lock nodes are
+    ``Class.lockattr`` and a ``with self._lock:`` body calling a method
+    of a sibling class (resolved by unique method name, the
+    Router→Replica shape) adds cross-class edges — two objects taking
+    each other's locks in opposite orders is the deadlock review cannot
+    see from either class alone."""
+    findings = []
+    by_module: Dict[str, List[ClassModel]] = {}
+    for rel, model in _iter_classes(tree):
+        by_module.setdefault(rel, []).append(model)
+
+    for rel, models in by_module.items():
+        # Per-function transitive lock sets per class (one fixpoint pass
+        # is enough at the call-graph depths in this codebase), plus a
+        # unique-method-name index for cross-class call resolution.
+        trans: Dict[Tuple[str, str], Set[str]] = {}
+        method_owner: Dict[str, Optional[ClassModel]] = {}
+        for model in models:
+            cname = model.cls.name
+            t = {k: {f"{cname}.{lk}" if not lk.startswith("<module>")
+                     else lk for lk, _ in f.acquires}
+                 for k, f in model.funcs.items()}
+            for _ in range(4):
+                changed = False
+                for k, f in model.funcs.items():
+                    for callee in f.calls:
+                        extra = t.get(callee, set()) - t[k]
+                        if extra:
+                            t[k] |= extra
+                            changed = True
+                if not changed:
+                    break
+            for k, v in t.items():
+                trans[(cname, k)] = v
+            for k in model.funcs:
+                if "." in k:
+                    continue
+                if k in method_owner and method_owner[k] is not model:
+                    method_owner[k] = None  # ambiguous: never resolved
+                else:
+                    method_owner[k] = model
+
+        edges: Dict[str, Set[str]] = {}
+        edge_lines: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+        def note_edge(a: str, b: str, func: str, line: int) -> None:
+            edges.setdefault(a, set()).add(b)
+            edge_lines.setdefault((a, b), (func, line))
+
+        for model in models:
+            cname = model.cls.name
+
+            def qual(lk: str, cname=cname) -> str:
+                return lk if lk.startswith("<module>") else f"{cname}.{lk}"
+
+            for key, info in model.funcs.items():
+                for acc in info.accesses:
+                    if acc.kind == "reacquire" and \
+                            acc.attr in model.plain_lock_attrs:
+                        findings.append(Finding(
+                            "lock-order-cycle", rel, acc.line,
+                            f"'{cname}.{acc.attr}' is a "
+                            f"non-reentrant threading.Lock re-acquired "
+                            f"on a path that already holds it "
+                            f"({acc.func}:{acc.line}) — self-deadlock"))
+
+            for key, info in model.funcs.items():
+                # direct lexical nesting edges (recorded in _walk_func's
+                # single guard-stack pass)
+                for held_lk, acq_lk, line in info.acquire_edges:
+                    note_edge(qual(held_lk), qual(acq_lk), key, line)
+                # calls made with locks held: propagate the callee's
+                # transitive acquisitions (intra-class by name, sibling
+                # classes by unique method name — the Router↔Replica
+                # shape).
+                for call, held in info.guarded_calls:
+                    callee_locks: Set[str] = set()
+                    callee_name = None
+                    a = _self_attr(call.func)
+                    if a and a in model.funcs:
+                        callee_name = a
+                        callee_locks = trans.get((cname, a), set())
+                    elif isinstance(call.func, ast.Name):
+                        nested = f"{key.split('.')[0]}.{call.func.id}"
+                        if nested in model.funcs:
+                            callee_name = nested
+                            callee_locks = trans.get((cname, nested),
+                                                     set())
+                    elif isinstance(call.func, ast.Attribute) and not a:
+                        owner = method_owner.get(call.func.attr)
+                        if owner is not None and owner is not model:
+                            callee_name = (f"{owner.cls.name}."
+                                           f"{call.func.attr}")
+                            callee_locks = trans.get(
+                                (owner.cls.name, call.func.attr), set())
+                    for lk in callee_locks:
+                        for h in (qual(x) for x in held):
+                            if h != lk:
+                                note_edge(h, lk, key, call.lineno)
+                            elif lk.split(".")[-1] in \
+                                    model.plain_lock_attrs and \
+                                    lk.startswith(cname + "."):
+                                findings.append(Finding(
+                                    "lock-order-cycle", rel,
+                                    call.lineno,
+                                    f"'{lk}' (non-reentrant Lock) "
+                                    f"is held at {key}:{call.lineno} "
+                                    f"while calling "
+                                    f"'{callee_name}', which "
+                                    f"acquires it again — "
+                                    f"self-deadlock"))
+
+        # cycle detection over the module-wide acquisition edges
+        seen_cycles = set()
+        for start in edges:
+            stack = [(start, (start,))]
+            while stack:
+                cur, path = stack.pop()
+                for nxt in edges.get(cur, ()):
+                    if nxt == start and len(path) > 1:
+                        cyc = tuple(sorted(path))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        fn, line = edge_lines[(cur, nxt)]
+                        findings.append(Finding(
+                            "lock-order-cycle", rel, line,
+                            f"lock acquisition cycle: "
+                            f"{' -> '.join(path + (start,))} — two "
+                            f"threads taking these locks in opposite "
+                            f"orders deadlock; pick one global order "
+                            f"(docs/CHECKS.md concurrency)"))
+                    elif nxt not in path:
+                        stack.append((nxt, path + (nxt,)))
+    return findings
+
+
+def rule_blocking_under_lock(tree: SourceTree) -> List[Finding]:
+    """join/queue-get/IO inside a ``with lock:`` body."""
+    findings = []
+    for rel, model in _iter_classes(tree):
+        for key, info in model.funcs.items():
+            for call, held in info.guarded_calls:
+                hazard = _blocking_hazard(call, model)
+                if hazard:
+                    what, why = hazard
+                    findings.append(Finding(
+                        "blocking-under-lock", rel, call.lineno,
+                        f"{what} inside a `with "
+                        f"{'/'.join(held)}:` body "
+                        f"({key}:{call.lineno}): {why} — every "
+                        f"other acquirer of the lock now waits "
+                        f"on it (the PR 5 drain-hang shape); "
+                        f"move the blocking operation outside "
+                        f"the critical section"))
+    return findings
+
+
+def _blocking_hazard(call: ast.Call, model: ClassModel
+                     ) -> Optional[Tuple[str, str]]:
+    resolved = _call_name(call, model.aliases) or ""
+    if resolved in _BLOCKING_EXACT:
+        return resolved, _BLOCKING_EXACT[resolved]
+    if resolved.startswith(("socket.", "subprocess.")):
+        return resolved, "blocking system call"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    method = call.func.attr
+    recv = _self_attr(call.func.value)
+    nonblocking = any(
+        kw.arg in ("block",) and isinstance(kw.value, ast.Constant)
+        and kw.value.value is False for kw in call.keywords) or \
+        any(kw.arg == "timeout" and isinstance(kw.value, ast.Constant)
+            and kw.value.value in (0, 0.0) for kw in call.keywords)
+    if recv in model.channel_attrs and recv not in model.lock_attrs:
+        if method in _QUEUE_BLOCKING_METHODS and not nonblocking:
+            return (f"self.{recv}.{method}()",
+                    "blocking queue operation (waits for a peer that "
+                    "may itself need this lock)")
+        if method in _EVENT_BLOCKING_METHODS and not nonblocking:
+            return (f"self.{recv}.{method}()",
+                    "event wait (the setter may need this lock)")
+    if recv in model.thread_attrs and method in _THREAD_BLOCKING_METHODS:
+        return (f"self.{recv}.join()",
+                "thread join (the joined thread may need this lock)")
+    if method == "sleep" and resolved == "time.sleep":
+        return resolved, _BLOCKING_EXACT["time.sleep"]
+    return None
+
+
+def rule_daemon_shared_teardown(tree: SourceTree) -> List[Finding]:
+    """close() frees state a still-running daemon thread uses."""
+    findings = []
+    for rel, model in _iter_classes(tree):
+        daemon_entries = [e for e, d in model.thread_entries.items() if d]
+        if not daemon_entries:
+            continue
+        daemon_ctxs = {f"thread:{e}" for e in daemon_entries}
+        # attrs a daemon context touches, with the guards of each touch
+        daemon_uses: Dict[str, List[Access]] = {}
+        for info in model.funcs.values():
+            if not (info.contexts & daemon_ctxs):
+                continue
+            for acc in info.accesses:
+                if acc.kind in ("read", "write"):
+                    daemon_uses.setdefault(acc.attr, []).append(acc)
+        for name in TEARDOWN_NAMES:
+            info = model.funcs.get(name)
+            if info is None:
+                continue
+            stops = _has_stop_marker(info, model)
+            frees: List[Tuple[str, int, frozenset]] = []
+            for acc in info.accesses:
+                if (acc.kind == "write" and acc.wrote_none) or \
+                        acc.kind == "free":
+                    frees.append((acc.attr, acc.line, acc.guards))
+            for attr, line, guards in frees:
+                uses = daemon_uses.get(attr)
+                if not uses or attr in model.channel_attrs:
+                    continue
+                if stops:
+                    continue  # thread stopped/joined before the free
+                # swap-lock idiom: free AND every daemon use under one
+                # common lock serializes teardown against the thread.
+                common = guards.intersection(
+                    *[u.guards for u in uses]) if uses else frozenset()
+                if guards and common:
+                    continue
+                findings.append(Finding(
+                    "daemon-shared-teardown", rel, line,
+                    f"'{model.cls.name}.{name}()' frees 'self.{attr}' "
+                    f"({name}:{line}) while daemon thread context(s) "
+                    f"{sorted(daemon_ctxs)} still use it (e.g. "
+                    f"{uses[0].func}:{uses[0].line}) and nothing stops "
+                    f"the thread first — join/stop-event the thread "
+                    f"before freeing, or serialize both sides under one "
+                    f"lock (the serve backend's _swap_lock idiom)"))
+    return findings
+
+
+def _has_stop_marker(info: FuncInfo, model: ClassModel) -> bool:
+    for call in ast.walk(info.node):
+        if isinstance(call, ast.Call) and \
+                isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _STOP_MARKERS:
+            return True
+    return False
+
+
+CONCURRENCY_RULES = {
+    "unguarded-shared-write": rule_unguarded_shared_write,
+    "inconsistent-guard": rule_inconsistent_guard,
+    "lock-order-cycle": rule_lock_order_cycle,
+    "blocking-under-lock": rule_blocking_under_lock,
+    "daemon-shared-teardown": rule_daemon_shared_teardown,
+}
+
+
+def run_concurrency(root: str, select: Optional[Iterable[str]] = None,
+                    files: Optional[Iterable[str]] = None,
+                    tree: Optional[SourceTree] = None) -> List[Finding]:
+    """Run the concurrency rules over ``root``; pragma suppression
+    applied. Same contract as ``run_jaxlint``. ``tree`` reuses a
+    pre-parsed SourceTree (the CLI builds one and shares it across the
+    AST engines). Parse failures are findings here too — an engine that
+    analyzed an unparseable file as an empty module would report the
+    very file it exists to check as clean."""
+    tree = tree if tree is not None else SourceTree(root, files=files)
+    selected = set(select) if select else set(CONCURRENCY_RULES)
+    unknown = selected - set(CONCURRENCY_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s) {sorted(unknown)}; "
+                         f"have {sorted(CONCURRENCY_RULES)}")
+    findings: List[Finding] = list(tree.parse_errors)
+    for rule_id in sorted(selected):
+        findings.extend(CONCURRENCY_RULES[rule_id](tree))
+    return apply_pragmas(findings, tree.sources)

@@ -172,6 +172,16 @@ MATRIX: Tuple[MatrixEntry, ...] = (
     _e("cifar10_rn8_f32_mesh8_perreplica_fused", data_axis=8,
        sync_bn=False, fused=True),
     _e("cifar10_rn8_f32_mesh4x2", data_axis=4, model_axis=2),
+    # 2-D ("batch","model") pod shape with cross-replica optimizer
+    # sharding — ROADMAP item 1 pre-work: the pod-shaped program (zero1
+    # reduce-scatter/all-gather over the 4-way data axis of a 4x2 mesh)
+    # is golden-pinned (jaxpr + memory budget) and donation-verified on
+    # the concrete 8-device mesh, so pod correctness is check-reviewable
+    # before any pod exists.
+    _e("cifar10_rn8_f32_mesh4x2_zero1", data_axis=4, model_axis=2,
+       partition="zero1", check_lowering=True),
+    _e("imagenet_rn18_bf16_mesh4x2", dataset="imagenet", size=18,
+       dtype="bfloat16", data_axis=4, model_axis=2),
     # --- depth / width ------------------------------------------------
     _e("cifar10_rn20_bf16", size=20, dtype="bfloat16"),
     _e("cifar10_rn50_bf16", size=50, dtype="bfloat16"),

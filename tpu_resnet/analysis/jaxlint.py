@@ -874,12 +874,14 @@ RULES = {
 
 
 def run_jaxlint(root: str, select: Optional[Iterable[str]] = None,
-                files: Optional[Iterable[str]] = None) -> List[Finding]:
+                files: Optional[Iterable[str]] = None,
+                tree: Optional["SourceTree"] = None) -> List[Finding]:
     """Run the AST rules over ``root``; pragma suppression applied.
 
     ``select`` limits to a subset of rule ids; ``files`` limits the file
-    set (root-relative paths)."""
-    tree = SourceTree(root, files=files)
+    set (root-relative paths); ``tree`` reuses a pre-parsed SourceTree
+    (the CLI parses once and shares it across the AST engines)."""
+    tree = tree if tree is not None else SourceTree(root, files=files)
     selected = set(select) if select else set(RULES)
     unknown = selected - set(RULES)
     if unknown:

@@ -1,0 +1,377 @@
+"""`spmd` — SPMD-divergence lint, engine 4b of `tpu-resnet check`.
+
+ROADMAP item 1 moves this repo to one process per host on a pod-scale
+``("batch", "model")`` mesh. On a pod, every process must execute the
+SAME program in the same order: control flow that diverges by
+``process_index`` around a compile, a registry dispatch or a collective
+is no longer an exception on one host — it is a silent all-host HANG
+(process 0 sits in a collective the other processes never entered). The
+GSPMD/pjit literature (PAPERS: "GSPMD", "Scalable Training of Language
+Models using JAX pjit and TPUv4") kills this class by construction:
+single program, sharding annotations only, host-divergent work limited
+to I/O. This engine makes that discipline a checked rule before any pod
+exists.
+
+Rules (each with a seeded fixture in tests/fixtures/analysis/):
+
+process-divergent-dispatch  an ``if`` conditioned on process identity
+                            (``process_index()``/``is_primary()``/
+                            ``process_id``) whose gated branch builds or
+                            dispatches a compiled program (``jax.jit``/
+                            ``pjit``/``make_jaxpr``/the repo's canonical
+                            step constructors/the program registry) or
+                            runs a collective (``jax.lax.psum``-family,
+                            ``multihost_utils``). Host-side primary-only
+                            work (logging, metrics files, checkpoint
+                            bookkeeping) is exactly what the guard is
+                            FOR and stays silent.
+primary-only-write          the shared ``train_dir`` artifacts
+                            (manifest.json, topology.json, …) each have
+                            ONE canonical atomic, primary-only writer
+                            (``obs/manifest.write_manifest``,
+                            ``resilience/elastic.write_topology``, …).
+                            Any other function that opens one of them
+                            for writing is a finding — on a shared
+                            train_dir, N processes writing the same file
+                            is a torn-record generator, and the helper
+                            discipline (tmp + os.replace + is_primary)
+                            is the established fix. The allowlist is
+                            verified against the tree, so a renamed
+                            helper fails loudly instead of silently
+                            un-protecting its artifact.
+unordered-iteration-to-program  iteration over a ``set`` literal /
+                            ``set()``/``frozenset()`` value (or an
+                            unsorted ``os.listdir``/``glob.glob``)
+                            inside the program-construction modules.
+                            Python set order varies across processes
+                            (PYTHONHASHSEED); feeding it into program
+                            construction or key spelling makes two
+                            hosts build different programs — the same
+                            divergence class, one layer down. Wrap the
+                            iterable in ``sorted(...)``.
+
+Pure ``ast`` — never imports jax; same Finding/pragma/baseline machinery
+as jaxlint and the concurrency engine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from tpu_resnet.analysis.findings import Finding, apply_pragmas
+from tpu_resnet.analysis.jaxlint import (SourceTree, _alias_map, _dotted,
+                                         _identifiers, _resolved)
+
+# Identifiers in an `if` test that mark process-divergent control flow.
+PROCESS_IDENTITY = {"process_index", "is_primary", "process_id"}
+
+# Program construction / dispatch / collective markers.
+_DISPATCH_EXACT = {
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "jax.make_jaxpr",
+    "jax.distributed.initialize",
+}
+_DISPATCH_PREFIXES = ("jax.experimental.multihost_utils",)
+# jax.lax collectives + multihost utils, matched as attribute/function
+# names (psum through an alias, multihost_utils.sync_global_devices...).
+_COLLECTIVE_NAMES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "reduce_scatter", "sync_global_devices",
+    "process_allgather", "broadcast_one_to_all",
+}
+# The repo's canonical compiled-program constructors (train/step.py,
+# data/device_data.py, programs/registry.py): gating any of these on
+# process identity diverges the compiled-program set across hosts.
+_REPO_CONSTRUCTORS = {
+    "shard_step", "staged_chunk_jit", "compile_staged_stream_steps",
+    "compile_resident_steps", "make_train_step", "make_eval_step",
+    "build_eval_step", "wrap_train_step", "staged_chunk_hook",
+}
+# Registry dispatch: `<...registry...>.wrap(...)`.
+_REGISTRY_METHODS = {"wrap"}
+
+# One canonical writer per shared train_dir artifact. Writes of these
+# filenames anywhere else in the package are findings; the topology.json
+# / manifest.json discipline (atomic tmp+rename, primary-only) becomes a
+# rule instead of a convention. export/serialize.py owns the *export
+# bundle's* manifest.json (a different directory, same basename).
+SHARED_ARTIFACTS: Dict[str, Tuple[Tuple[str, str], ...]] = {
+    "manifest.json": (("tpu_resnet/obs/manifest.py", "write_manifest"),
+                      ("tpu_resnet/export/serialize.py", "save_inference")),
+    "topology.json": (("tpu_resnet/resilience/elastic.py",
+                       "write_topology"),),
+    "telemetry.json": (("tpu_resnet/obs/server.py",
+                        "TelemetryServer.maybe_start"),),
+    "flops.json": (("tpu_resnet/obs/mfu.py", "FlopsRegistry.save"),),
+    "memory.json": (("tpu_resnet/obs/memory.py", "MemoryLedger.save"),),
+    "autotune.json": (("tpu_resnet/ops/autotune.py", "dump"),),
+    "oom_report.json": (("tpu_resnet/obs/memory.py", "write_oom_report"),),
+}
+
+# Program-construction / key-spelling modules: set-order feeding these
+# is the cross-host divergence hazard the third rule pins.
+PROGRAM_SCOPE_FILES = (
+    "tpu_resnet/programs/registry.py",
+    "tpu_resnet/programs/__init__.py",
+    "tpu_resnet/train/step.py",
+    "tpu_resnet/data/device_data.py",
+    "tpu_resnet/analysis/configmatrix.py",
+    "tpu_resnet/analysis/memorybudget.py",
+    "tpu_resnet/tools/sweep_measure.py",
+    "tpu_resnet/obs/mfu.py",
+    "tpu_resnet/obs/memory.py",
+    "tpu_resnet/parallel/partition.py",
+    "tpu_resnet/parallel/zero.py",
+)
+
+
+def _functions(mod: ast.AST):
+    """(qualname, node) for module functions and class methods."""
+    for node in mod.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+def _dispatch_marker(call: ast.Call, aliases) -> Optional[str]:
+    resolved = _resolved(call.func, aliases) or ""
+    if resolved in _DISPATCH_EXACT:
+        return resolved
+    if resolved.startswith(_DISPATCH_PREFIXES):
+        return resolved
+    tail = resolved.rsplit(".", 1)[-1] if resolved else ""
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _COLLECTIVE_NAMES:
+            return f".{attr}()"
+        if attr in _REGISTRY_METHODS:
+            recv = _dotted(call.func.value) or ""
+            if "registry" in recv.lower():
+                return f"{recv}.{attr}()"
+    if tail in _REPO_CONSTRUCTORS or (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _REPO_CONSTRUCTORS):
+        return tail or call.func.id
+    if tail in _COLLECTIVE_NAMES:
+        return tail
+    return None
+
+
+def rule_process_divergent_dispatch(tree: SourceTree) -> List[Finding]:
+    """process-identity-gated jit/registry dispatch or collective."""
+    findings = []
+    for rel, mod in tree.trees.items():
+        if not rel.startswith("tpu_resnet/"):
+            continue
+        aliases = _alias_map(mod)
+        for node in ast.walk(mod):
+            if not isinstance(node, ast.If):
+                continue
+            idents = _identifiers(node.test)
+            if not (idents & PROCESS_IDENTITY):
+                continue
+            for branch, stmts in (("then", node.body),
+                                  ("else", node.orelse)):
+                for stmt in stmts:
+                    for call in ast.walk(stmt):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        marker = _dispatch_marker(call, aliases)
+                        if marker is None:
+                            continue
+                        findings.append(Finding(
+                            "process-divergent-dispatch", rel,
+                            call.lineno,
+                            f"{marker} runs only on some processes "
+                            f"(gated by "
+                            f"{'/'.join(sorted(idents & PROCESS_IDENTITY))} "
+                            f"at line {node.lineno}, {branch} branch): on "
+                            f"a multi-host mesh every process must build "
+                            f"and dispatch the same program in the same "
+                            f"order — a process-divergent collective or "
+                            f"compile is an all-host HANG, not an error. "
+                            f"Run it unconditionally and gate only the "
+                            f"host-side I/O (docs/PARALLELISM.md)"))
+                        break  # one finding per call-site is enough;
+                        #        keep walking remaining stmts
+    return findings
+
+
+def _expr_artifacts(node: ast.AST, tainted: Dict[str, set]) -> set:
+    """Artifact names an expression's value may name: exact string
+    constants plus names already tainted by such a constant."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and \
+                sub.value in SHARED_ARTIFACTS:
+            out.add(sub.value)
+        elif isinstance(sub, ast.Name) and sub.id in tainted:
+            out |= tainted[sub.id]
+    return out
+
+
+def _artifact_writers(mod: ast.AST, rel: str, aliases):
+    """(artifact, qualname, line) for every function in ``rel`` that
+    opens a shared artifact FOR WRITING. The artifact must flow into
+    the write call's path expression — exact string constants (the
+    ``os.path.join(dir, "manifest.json")`` idiom; substrings would
+    false-positive on docstrings and cousin filenames like
+    golden_memory.json) propagated through local assignments (``path =
+    join(...); tmp = path + ".tmpN"; open(tmp, "w")``). A function that
+    merely READS an artifact while writing some unrelated file is not a
+    writer."""
+    for qualname, fn in _functions(mod):
+        # local taint: name -> artifact set, two passes for the
+        # path-then-tmp chain.
+        tainted: Dict[str, set] = {}
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                arts = _expr_artifacts(node.value, tainted)
+                if not arts:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.setdefault(t.id, set()).update(arts)
+        hits: Dict[str, int] = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = _resolved(node.func, aliases) or ""
+            target = None
+            if resolved == "open" and len(node.args) >= 2 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    str(node.args[1].value).startswith(("w", "a")):
+                target = node.args[0]
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("write_text", "write_bytes"):
+                target = node.func.value
+            elif resolved == "os.replace" and len(node.args) == 2:
+                target = node.args[1]
+            if target is None:
+                continue
+            for artifact in _expr_artifacts(target, tainted):
+                hits.setdefault(artifact, node.lineno)
+        for artifact, line in sorted(hits.items()):
+            yield artifact, qualname, line
+
+
+# Diagnostic harnesses whose artifact writes land only in scratch dirs
+# they own (doctor drills fabricate/inspect artifacts in tempdirs) —
+# exempt from the shared-train_dir writer discipline.
+_DIAGNOSTIC_FILES = ("tpu_resnet/tools/doctor.py",)
+
+
+def rule_primary_only_write(tree: SourceTree) -> List[Finding]:
+    """shared train_dir artifacts only through their canonical writers."""
+    findings = []
+    for rel, mod in tree.trees.items():
+        if not rel.startswith("tpu_resnet/") or rel in _DIAGNOSTIC_FILES:
+            continue
+        aliases = _alias_map(mod)
+        for artifact, qualname, line in _artifact_writers(mod, rel,
+                                                          aliases):
+            allowed = SHARED_ARTIFACTS[artifact]
+            if (rel, qualname) in allowed:
+                continue
+            canonical = ", ".join(f"{p}::{q}" for p, q in allowed)
+            findings.append(Finding(
+                "primary-only-write", rel, line,
+                f"'{qualname}' writes the shared train_dir artifact "
+                f"'{artifact}' directly — on a shared directory every "
+                f"process would race this write (torn/clobbered "
+                f"records). Route it through the canonical atomic, "
+                f"primary-only writer ({canonical}), or add the new "
+                f"writer to analysis/spmd.py SHARED_ARTIFACTS with the "
+                f"same tmp+os.replace+is_primary discipline"))
+    # The allowlist must stay anchored to real code: a renamed canonical
+    # writer is reported (like guard-parity does), never silently
+    # un-protecting its artifact.
+    for artifact, pairs in sorted(SHARED_ARTIFACTS.items()):
+        for rel, qualname in pairs:
+            if not tree.has(rel):
+                continue
+            if not any(q == qualname for q, _ in _functions(tree.trees[rel])):
+                findings.append(Finding(
+                    "primary-only-write", rel, 0,
+                    f"canonical writer '{qualname}' of '{artifact}' not "
+                    f"found in {rel} — the primary-only-write contract "
+                    f"names it; update analysis/spmd.py SHARED_ARTIFACTS "
+                    f"if it moved intentionally"))
+    return findings
+
+
+def _unordered_iterable(node: ast.AST, aliases) -> Optional[str]:
+    if isinstance(node, ast.Set):
+        return "set literal"
+    if isinstance(node, ast.SetComp):
+        return "set comprehension"
+    if isinstance(node, ast.Call):
+        resolved = _resolved(node.func, aliases) or ""
+        if resolved in ("set", "frozenset"):
+            return f"{resolved}()"
+        if resolved in ("os.listdir", "glob.glob", "glob.iglob"):
+            return resolved
+    return None
+
+
+def rule_unordered_iteration(tree: SourceTree) -> List[Finding]:
+    """set/listdir-order feeding program construction or key spelling."""
+    findings = []
+    for rel in PROGRAM_SCOPE_FILES:
+        if not tree.has(rel):
+            continue
+        mod = tree.trees[rel]
+        aliases = _alias_map(mod)
+        iter_sites: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(mod):
+            if isinstance(node, ast.For):
+                kind = _unordered_iterable(node.iter, aliases)
+                if kind:
+                    iter_sites.append((node.iter, kind))
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp, ast.SetComp)):
+                for gen in node.generators:
+                    kind = _unordered_iterable(gen.iter, aliases)
+                    if kind:
+                        iter_sites.append((gen.iter, kind))
+        for site, kind in iter_sites:
+            findings.append(Finding(
+                "unordered-iteration-to-program", rel, site.lineno,
+                f"iteration over an unordered {kind} in a "
+                f"program-construction module: set/scan order varies "
+                f"across processes (PYTHONHASHSEED, filesystem), so two "
+                f"hosts can build programs or spell registry keys in "
+                f"different orders — wrap it in sorted(...) "
+                f"(docs/PARALLELISM.md)"))
+    return findings
+
+
+SPMD_RULES = {
+    "process-divergent-dispatch": rule_process_divergent_dispatch,
+    "primary-only-write": rule_primary_only_write,
+    "unordered-iteration-to-program": rule_unordered_iteration,
+}
+
+
+def run_spmd(root: str, select: Optional[Iterable[str]] = None,
+             files: Optional[Iterable[str]] = None,
+             tree: Optional[SourceTree] = None) -> List[Finding]:
+    """Run the SPMD-divergence rules over ``root``; pragma suppression
+    applied. Same contract as ``run_jaxlint``. ``tree`` reuses a
+    pre-parsed SourceTree; parse failures are findings here too (see
+    run_concurrency)."""
+    tree = tree if tree is not None else SourceTree(root, files=files)
+    selected = set(select) if select else set(SPMD_RULES)
+    unknown = selected - set(SPMD_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s) {sorted(unknown)}; "
+                         f"have {sorted(SPMD_RULES)}")
+    findings: List[Finding] = list(tree.parse_errors)
+    for rule_id in sorted(selected):
+        findings.extend(SPMD_RULES[rule_id](tree))
+    return apply_pragmas(findings, tree.sources)

@@ -157,16 +157,24 @@ class CheckpointBackend:
         # ``_ensure_restored`` and surfaces a failed restore with the
         # same RuntimeError the old synchronous path raised.
         self._restore_step = step
+        # Serializes the join+clear of the restore thread handle: the
+        # batcher thread (infer) and the warmup path can both reach
+        # _ensure_restored concurrently, and the handle must be cleared
+        # exactly once AFTER the join completed (clearing first would
+        # let the second caller skip the join and read _variables
+        # mid-restore). The restore thread itself never takes this lock.
+        self._restore_join_lock = threading.Lock()
         self._restore_thread = threading.Thread(
             target=self._load, args=(step,),
             name="tpu-resnet-serve-restore", daemon=True)
         self._restore_thread.start()
 
     def _ensure_restored(self) -> None:
-        t = self._restore_thread
-        if t is not None:
-            t.join()
-            self._restore_thread = None
+        with self._restore_join_lock:
+            t = self._restore_thread
+            if t is not None:
+                t.join()
+                self._restore_thread = None
         if self._variables is None:
             raise RuntimeError(
                 f"checkpoint step {self._restore_step} in "

@@ -242,7 +242,10 @@ class MicroBatcher:
         taken. A request that would overflow the batch is carried into
         the next round (never split — its images stay contiguous)."""
         if self._carry is not None:
-            first, self._carry = self._carry, None
+            # Deliberate lock-free handoff: _carry is worker-thread-only
+            # during normal operation; drain() touches it ONLY after the
+            # worker failed to exit (stuck mid-inference, so not here).
+            first, self._carry = self._carry, None  # check: disable=unguarded-shared-write
         else:
             try:
                 first = self._queue.get(timeout=self._idle_tick)[2]
@@ -369,7 +372,10 @@ class MicroBatcher:
             # queue for the NEXT batch would otherwise hang its client
             # for the full request-wait timeout. The worker only touches
             # _carry between batches, which a stuck worker is not.
-            carried, self._carry = self._carry, None
+            # Deliberate unlocked touch (see _gather): the worker only
+            # moves _carry between batches, which a stuck worker — the
+            # only path reaching this line — is not doing.
+            carried, self._carry = self._carry, None  # check: disable=unguarded-shared-write
             if carried is not None:
                 carried.set_error(Draining("server shut down before this "
                                            "request was served"))

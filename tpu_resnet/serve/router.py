@@ -290,11 +290,18 @@ class Router:
                 self._upsert_replica(rec["name"], rec["url"],
                                      rec.get("pid"), rec.get("run_id"))
         if self.run_id is None:
-            for rec in records:
-                if rec.get("run_id"):
-                    self.run_id = rec["run_id"]
-                    self.spans.run_id = self.run_id
-                    break
+            # Written under the replica lock: the prober thread and a
+            # direct probe_once() caller both come through here, and the
+            # first discovered run_id must win exactly once (bare reads
+            # elsewhere are the atomic-publish pattern the concurrency
+            # engine documents).
+            with self._lock:
+                if self.run_id is None:
+                    for rec in records:
+                        if rec.get("run_id"):
+                            self.run_id = rec["run_id"]
+                            self.spans.run_id = self.run_id
+                            break
 
     def replicas(self) -> List[Replica]:
         with self._lock:
@@ -445,8 +452,12 @@ class Router:
             return p50, p99
         with self._lat_lock:
             lat = sorted(self._latencies)
-        p50, p99 = percentile(lat, 0.50), percentile(lat, 0.99)
-        self._p_cache = (now, p50, p99)
+            p50, p99 = percentile(lat, 0.50), percentile(lat, 0.99)
+            # Cache written under the same lock as the ring it is
+            # derived from: the shed check (handler threads) and the
+            # prober both recompute here, and an unlocked write could
+            # publish a stale (asof, p50, p99) over a fresher one.
+            self._p_cache = (now, p50, p99)
         return p50, p99
 
     def _count(self, **deltas) -> None:
@@ -468,10 +479,11 @@ class Router:
             if stale:
                 # No completions for a while (possibly because we shed
                 # everything): the ring is evidence of the PAST fleet,
-                # not this one. Reset and admit.
+                # not this one. Reset and admit. The cache reset rides
+                # inside the same lock as the ring it mirrors.
                 self._latencies.clear()
+                self._p_cache = (0.0, 0.0, 0.0)
         if stale:
-            self._p_cache = (0.0, 0.0, 0.0)
             return None
         if not enough:
             return None
@@ -763,7 +775,12 @@ class Router:
         on their own handler threads — callers that are about to exit
         the process must :meth:`quiesce` before :meth:`close`, or those
         threads die with it."""
-        self._accepting = False
+        # Flag flip under the lock (the batcher's admission discipline,
+        # PR 5): handler threads read the flag bare — the documented
+        # atomic-publish pattern — but the write itself is serialized
+        # so the concurrency engine can prove one consistent writer.
+        with self._lock:
+            self._accepting = False
         self.registry.mark_unhealthy("draining")
 
     def quiesce(self, timeout: float) -> bool:

@@ -295,7 +295,8 @@ def _check_static_analysis(matrix: bool = True, timeout: int = 900) -> dict:
             out.update(errors=len(errors),
                        warnings=len(payload["findings"]) - len(errors),
                        baselined=len(payload["suppressed"]),
-                       stale_baseline=len(payload["stale_baseline"]))
+                       stale_baseline=len(payload["stale_baseline"]),
+                       engines=payload.get("engines", []))
             if matrix:
                 out["matrix_traced"] = payload.get("matrix",
                                                    {}).get("traced")
