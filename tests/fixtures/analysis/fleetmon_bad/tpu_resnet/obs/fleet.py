@@ -1,0 +1,46 @@
+"""PRE-FIX fleetmon scrape-ring race (seeded fixture — the bug shape
+the real aggregator was written to avoid; the engine must flag it
+mechanically).
+
+The daemon scraper thread appends each round to ``self._rounds`` and
+trims the ring with a bare rebind, while ``snapshot`` (called from the
+telemetry handler thread) reads the list bare. A snapshot racing the
+trim can read a half-rebound ring — or compute burn rate against a
+round the trim just dropped. The fixed code
+(tpu_resnet/obs/fleet.py) does the ring append/trim and every counter
+bump under ``self._lock`` and keeps the scrape I/O outside it.
+"""
+
+import threading
+import time
+
+
+class FleetAggregator:
+    def __init__(self, scrape_fn, interval=2.0):
+        self._scrape = scrape_fn
+        self._interval = interval
+        self._rounds = []
+        self._scrapes = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            merged = self._scrape()
+            # BUG: bare ring append + trim-rebind from the scraper
+            # thread while snapshot() reads the list unguarded.
+            self._rounds.append({"wall": time.time(), "merged": merged})
+            self._rounds = self._rounds[-4096:]
+            self._scrapes = self._scrapes + 1
+            self._stop.wait(self._interval)
+
+    def snapshot(self):
+        # BUG: unlocked read racing the scraper's trim-rebind.
+        last = self._rounds[-1] if self._rounds else None
+        return {"rounds": len(self._rounds),
+                "scrapes": self._scrapes, "last": last}
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

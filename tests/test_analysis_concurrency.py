@@ -65,6 +65,20 @@ def test_hedge_attribution_fixture_flagged():
     assert "caller:route_predict" in msgs
 
 
+def test_fleetmon_scrape_ring_fixture_flagged():
+    """PR 14 pre-fix shape: the fleetmon scraper thread appending to /
+    trim-rebinding the round ring bare while snapshot() (telemetry
+    handler thread) reads it unguarded — the race the shipped
+    aggregator serializes under its lock."""
+    found = conc_findings("fleetmon_bad", "unguarded-shared-write")
+    msgs = "\n".join(f.format() for f in found)
+    assert "_rounds" in msgs, msgs
+    # both sides of the race are reported: the scraper thread context
+    # and the snapshot read site
+    assert "thread:_loop" in msgs
+    assert "snapshot:" in msgs
+
+
 def test_swap_lock_fixture_flagged():
     """PR 11 pre-fix: the restore thread publishing the weight swap bare
     while another site swaps under the lock, and close() freeing the
@@ -429,6 +443,15 @@ def test_router_drain_flip_is_locked():
     found = [f for f in run_concurrency(
         REPO, files=["tpu_resnet/serve/router.py"])
         if f.rule in ("unguarded-shared-write", "inconsistent-guard")]
+    assert found == [], "\n".join(f.format() for f in found)
+
+
+def test_fleet_aggregator_is_clean_under_engine():
+    """The shipped aggregator is the fixed twin of the fleetmon_bad
+    fixture: ring/counter mutation under the lock, scrape I/O and span
+    writes outside it — the engine stays clean on obs/fleet.py."""
+    found = [f for f in run_concurrency(
+        REPO, files=["tpu_resnet/obs/fleet.py"])]
     assert found == [], "\n".join(f.format() for f in found)
 
 

@@ -1,0 +1,284 @@
+"""Fleet observability plane (tpu_resnet/obs/fleet.py + the tail
+sampler it rides on): histogram-merge exactness vs numpy, sublinear
+span volume under tail sampling, burn-rate math, endpoint discovery,
+a live two-replica scrape round, and the obs_scrape --fleet table."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpu_resnet.config import load_config
+from tpu_resnet.obs.fleet import (FLEET_TIMESERIES_FILE, FleetAggregator,
+                                  burn_rate, cumulative_at,
+                                  discover_endpoints)
+from tpu_resnet.obs.server import (LATENCY_BUCKETS_MS, SERVE_GAUGES,
+                                   SERVE_HISTOGRAMS, Histogram,
+                                   TelemetryRegistry, TelemetryServer,
+                                   histogram_quantile, merge_histograms)
+from tpu_resnet.obs.spans import TailSampler
+from tpu_resnet.serve.discovery import write_record
+from tpu_resnet.tools import obs_scrape
+
+
+# --------------------------------------------------------------- merging
+
+def _hist_of(samples):
+    h = Histogram("serve_latency_ms", edges=LATENCY_BUCKETS_MS)
+    for s in samples:
+        h.observe(s)
+    return h.snapshot()
+
+
+def test_merge_histograms_matches_numpy_pooling():
+    """Summing cumulative counts position-wise IS pooling: every merged
+    bucket count equals numpy's count of pooled samples <= that edge,
+    and the merged quantile equals the quantile of the pooled snapshot
+    built directly from all samples."""
+    rng = np.random.default_rng(7)
+    a = rng.gamma(2.0, 8.0, size=400)          # healthy replica
+    b = rng.gamma(2.0, 80.0, size=100)         # degraded replica
+    merged = merge_histograms([_hist_of(a), _hist_of(b)])
+    pooled = np.concatenate([a, b])
+    assert merged["count"] == pooled.size
+    assert merged["sum"] == pytest.approx(pooled.sum())
+    for edge, cum in merged["buckets"]:
+        if math.isinf(edge):
+            assert cum == pooled.size
+        else:
+            assert cum == int(np.sum(pooled <= edge))
+    direct = _hist_of(pooled)
+    for q in (0.5, 0.95, 0.99):
+        assert histogram_quantile(merged, q) == pytest.approx(
+            histogram_quantile(direct, q))
+    # and the pooled p99 is NOT the average of per-replica p99s
+    avg_p99 = (histogram_quantile(_hist_of(a), 0.99)
+               + histogram_quantile(_hist_of(b), 0.99)) / 2
+    assert histogram_quantile(merged, 0.99) != pytest.approx(avg_p99)
+
+
+def test_merge_histograms_mismatched_edges_is_loud():
+    good = _hist_of([5.0, 50.0])
+    skewed = Histogram("serve_latency_ms", edges=(1.0, 10.0, 100.0))
+    skewed.observe(5.0)
+    with pytest.raises(ValueError, match="mismatched bucket edges"):
+        merge_histograms([good, skewed.snapshot()])
+
+
+def test_merge_histograms_empty_and_none_inputs():
+    assert merge_histograms([]) == {"buckets": [], "sum": 0.0,
+                                    "count": 0}
+    assert merge_histograms([None, {}, {"buckets": []}]) == {
+        "buckets": [], "sum": 0.0, "count": 0}
+    one = _hist_of([3.0])
+    assert merge_histograms([None, one]) == one
+
+
+# --------------------------------------------------------- tail sampling
+
+def test_tail_sampler_always_keeps_incident_classes():
+    s = TailSampler()
+    assert s.observe(1.0, error=True) == "error"
+    assert s.observe(1.0, shed=True) == "shed"
+    assert s.observe(1.0, retried=True) == "retry"
+    assert s.observe(1.0, hedged=True) == "hedge"
+    # error outranks the others when several apply
+    assert s.observe(1.0, error=True, shed=True) == "error"
+
+
+def test_tail_sampler_keeps_the_slow_tail():
+    s = TailSampler(quantile=0.95)
+    for _ in range(200):
+        s.observe(10.0)
+    assert s.stats()["slow_threshold_ms"] == pytest.approx(10.0)
+    assert s.observe(500.0) == "slow"
+    assert s.observe(10.0) in (None, "sampled")
+
+
+def test_tail_sampler_span_volume_is_sublinear():
+    """Constant-latency traffic (no errors, no tail) must produce
+    O(log N) kept spans: the baseline period doubles every 64 keeps, so
+    10x the requests yields well under 2x the spans — the acceptance
+    bar that kept-span volume grows sublinearly with request count."""
+    kept_at = {}
+    s = TailSampler()
+    n = 0
+    for target in (5_000, 50_000):
+        while n < target:
+            s.observe(10.0)
+            n += 1
+        kept_at[target] = s.stats()["kept"]
+    assert kept_at[5_000] < 100           # vs 5000 if linear
+    # 10x the traffic must cost well under 4x the spans (O(log N))
+    assert kept_at[50_000] < 4 * kept_at[5_000]
+    # the thinning period really did grow
+    assert s.stats()["period"] > TailSampler().stats()["period"]
+
+
+# ------------------------------------------------------- burn-rate math
+
+def test_cumulative_at_matches_numpy_interpolation():
+    samples = np.array([0.5, 1.5, 3.0, 7.0, 15.0, 40.0, 900.0, 9999.0])
+    snap = _hist_of(samples)
+    for edge in LATENCY_BUCKETS_MS:
+        assert cumulative_at(snap, edge) == pytest.approx(
+            np.sum(samples <= edge))
+    # past the largest finite edge the overflow bucket never counts
+    assert cumulative_at(snap, 1e12) == pytest.approx(
+        np.sum(samples <= LATENCY_BUCKETS_MS[-1]))
+    # mid-bucket reads interpolate within the bucket, monotonically
+    assert cumulative_at(snap, 0.0) == 0.0
+    assert (cumulative_at(snap, 30.0) <= cumulative_at(snap, 45.0)
+            <= cumulative_at(snap, 50.0))
+
+
+def test_burn_rate_against_hand_count():
+    old = _hist_of([1.0] * 10)
+    # window adds 10 requests: 5 fast (1ms), 5 blown (400ms) vs 10ms SLO
+    cur = merge_histograms([old, _hist_of([1.0] * 5 + [400.0] * 5)])
+    # bad_frac 0.5 over a 10% budget -> burning 5x the budget
+    assert burn_rate(cur, old, slo_ms=10.0,
+                     slo_target=0.9) == pytest.approx(5.0)
+    # empty window and time-reversed snapshots both read 0, never nan
+    assert burn_rate(old, old, 10.0, 0.9) == 0.0
+    assert burn_rate(old, cur, 10.0, 0.9) == 0.0
+
+
+# ------------------------------------------------------------ discovery
+
+def test_discover_endpoints_kinds_dedup_and_torn_files(tmp_path):
+    d = str(tmp_path)
+    write_record(d, "route.json", 7001)
+    write_record(d, "serve-r0.json", 7002, extra={"run_id": "abc"})
+    write_record(d, "serve.json", 7003)
+    write_record(d, "telemetry.json", 7004)
+    write_record(d, "telemetry-host1.json", 7004)     # duplicate port
+    write_record(d, "fleetmon.json", 7005)            # self — excluded
+    (tmp_path / "serve-torn.json").write_text('{"port": 70')
+    (tmp_path / "notes.json").write_text('{"port": 7006}')
+    eps = discover_endpoints(d)
+    by_name = {e["name"]: e for e in eps}
+    assert {(e["kind"], e["port"]) for e in eps} == {
+        ("route", 7001), ("serve", 7002), ("serve", 7003),
+        ("train", 7004)}
+    assert by_name["router"]["url"] == "http://127.0.0.1:7001"
+    assert by_name["r0"]["run_id"] == "abc"
+    # telemetry-host1.json sorts before telemetry.json, so the
+    # hostname-keyed twin wins the duplicate-port collapse
+    assert "default" in by_name and "host1" in by_name
+    assert discover_endpoints(str(tmp_path / "nowhere")) == []
+
+
+# ------------------------------------------------- live aggregator round
+
+def _serve_registry(latencies):
+    reg = TelemetryRegistry(stale_after_sec=300.0, gauges=SERVE_GAUGES,
+                            histograms=SERVE_HISTOGRAMS)
+    for ms in latencies:
+        reg.observe("serve_latency_ms", ms)
+    reg.heartbeat(1)
+    return reg
+
+
+def _fleet_cfg(directory, **fleet_overrides):
+    cfg = load_config()
+    cfg.fleet.discover_dir = directory
+    cfg.fleet.port = -1
+    for k, v in fleet_overrides.items():
+        setattr(cfg.fleet, k, v)
+    return cfg
+
+
+def test_fleet_aggregator_scrape_once_merges_live_replicas(tmp_path):
+    d = str(tmp_path)
+    r0 = TelemetryServer(_serve_registry([5.0] * 90), port=0,
+                         host="127.0.0.1")
+    r1 = TelemetryServer(_serve_registry([5.0] * 5 + [900.0] * 5),
+                         port=0, host="127.0.0.1")
+    write_record(d, "serve-r0.json", r0.port)
+    write_record(d, "serve-r1.json", r1.port)
+    write_record(d, "serve-dead.json", 1)             # nothing listens
+    agg = FleetAggregator(_fleet_cfg(d, slo_ms=50.0,
+                                     scrape_timeout_secs=2.0))
+    try:
+        record = agg.scrape_once()
+    finally:
+        agg.close()
+        r0.close()
+        r1.close()
+    assert record["endpoints"] == 3
+    assert record["up"] == 2 and record["errors"] == 1
+    assert record["fleet"]["count"] == 100
+    # the degraded replica's stragglers dominate the POOLED p99 even
+    # though 90% of fleet traffic came from the healthy replica
+    assert record["fleet"]["p99_ms"] > record["per"]["r0"]["serve_p99_ms"]
+    assert record["per"]["r0"]["healthy"] is True
+    assert record["per"]["r0"]["requests"] == 90
+    assert "error" in record["per"]["dead"]
+    assert record["burn_rate_fast"] > 0.0
+    # gauges published for fleetmon's own /metrics
+    m = agg.registry.render()
+    assert "tpu_resnet_fleet_endpoints_up 2" in m
+    assert "tpu_resnet_fleet_requests_total 100" in m
+    # one torn-tail-tolerant timeseries line per round
+    lines = [json.loads(ln) for ln in
+             open(os.path.join(d, FLEET_TIMESERIES_FILE))]
+    assert len(lines) == 1 and lines[0]["fleet"]["count"] == 100
+
+
+def test_burn_alert_fires_and_clears_across_rounds(tmp_path):
+    cfg = _fleet_cfg(str(tmp_path), slo_ms=10.0, slo_target=0.9,
+                     burn_alert_fast=5.0, burn_alert_slow=5.0,
+                     fast_window_secs=60.0, slow_window_secs=600.0)
+    clock = {"t": 1000.0}
+    agg = FleetAggregator(cfg, clock=lambda: clock["t"])
+    try:
+        empty = {"buckets": [], "sum": 0.0, "count": 0}
+        assert agg._note_round(clock["t"], empty)[2:] == (False, False)
+        clock["t"] += 5
+        hot = _hist_of([400.0] * 100)           # all blown vs 10ms SLO
+        fast, slow, fired, cleared = agg._note_round(clock["t"], hot)
+        assert fired and not cleared
+        assert fast == pytest.approx(10.0) and slow == pytest.approx(10.0)
+        # still hot -> no re-fire while the alert holds
+        clock["t"] += 5
+        assert agg._note_round(clock["t"], hot)[2:] == (False, False)
+        # a quiet hour: windows see no new requests -> burn 0 -> clear
+        clock["t"] += 3600
+        fast, slow, fired, cleared = agg._note_round(clock["t"], hot)
+        assert cleared and not fired and fast == 0.0
+        snap = agg.snapshot()
+        assert snap["alerts"] == 1 and snap["alert_active"] is False
+        assert snap["rounds"] == 4
+    finally:
+        agg.close()
+
+
+# --------------------------------------------------- obs_scrape --fleet
+
+def test_obs_scrape_fleet_table_and_exit_codes(tmp_path, capsys):
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    assert obs_scrape.main(["--fleet", empty]) == 2
+
+    d = str(tmp_path)
+    reg = _serve_registry([5.0] * 20)
+    srv = TelemetryServer(reg, port=0, host="127.0.0.1")
+    write_record(d, "serve-r0.json", srv.port)
+    write_record(d, "serve-dead.json", 1)
+    try:
+        assert obs_scrape.main(["--fleet", d]) == 3   # one endpoint down
+        out = capsys.readouterr().out
+        assert "r0" in out and "DOWN" in out
+        assert "(histogram merge)" in out             # fleet rollup row
+        report = obs_scrape.scrape_fleet(d, timeout=2.0)
+        assert report["fleet"]["count"] == 20
+        os.remove(os.path.join(d, "serve-dead.json"))
+        assert obs_scrape.main(["--fleet", d, "--json"]) == 0
+        assert json.loads(capsys.readouterr().out)["fleet"]["count"] == 20
+    finally:
+        srv.close()
+    with pytest.raises(SystemExit):                   # modes are exclusive
+        obs_scrape.main(["--fleet", d, "--url", "localhost:1"])

@@ -688,7 +688,10 @@ def test_loadgen_replica_kill_scenario_end_to_end(tmp_path):
     try:
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline and sum(
-                1 for r in router.replicas() if r.healthy) < 2:
+                1 for r in router.replicas()
+                if r.healthy and r.image_shape) < 2:
+            # healthy AND probed: run_load needs the router's /info to
+            # forward the image_shape its probes learned
             time.sleep(0.05)
         from tools.loadgen import run_load
 
@@ -788,3 +791,59 @@ def test_hedged_attempt_failure_is_attributed_once(tmp_path):
         assert code in (502, 503)
     finally:
         router.close()
+
+
+# ------------------------------------------------- distributed tracing
+def test_trace_id_minted_echoed_and_spanned(fleet):
+    """The router is a minting authority: a client-supplied X-Trace-Id
+    echoes verbatim, an absent one is minted; after the tail-sampler's
+    first baseline period a route_request span lands with per-leg
+    attribution under that id."""
+    from tpu_resnet.obs.spans import load_spans
+    from tpu_resnet.obs.trace import ROUTE_EVENTS_FILE
+
+    router, (s0, s1), d, rid = fleet
+    code, out, headers = _post(router.port, _img(2).tobytes(), "1,8,8,3",
+                               headers={"X-Trace-Id": "cli-abc"})
+    assert code == 200
+    assert headers.get("X-Trace-Id") == "cli-abc"
+    code, out, headers = _post(router.port, _img(2).tobytes(), "1,8,8,3")
+    assert code == 200
+    minted = headers.get("X-Trace-Id")
+    assert minted and len(minted) == 16
+    # drive past the sampler's base period: a baseline keep is
+    # deterministic within 50 observations
+    for i in range(60):
+        _post(router.port, _img(i % 7).tobytes(), "1,8,8,3")
+    spans = [s for s in load_spans(os.path.join(d, ROUTE_EVENTS_FILE))
+             if s.get("span") == "route_request"]
+    assert spans, "no route_request span after 62 requests"
+    s = spans[0]
+    assert s["trace_id"] and s["status"] == 200
+    assert s["lane"] == "interactive"
+    assert s["replica"] in ("r0", "r1")
+    assert s["sampled"] in ("sampled", "slow")
+    assert s["legs"] and s["legs"][-1]["answered"] == s["replica"]
+    assert s["run_id"] == rid
+
+
+def test_trace_id_echoed_on_shed_and_error_paths(fleet):
+    """Every response path carries the trace id back — including 429
+    shed and 5xx — and sheds/errors are always-keep span classes."""
+    from tpu_resnet.obs.spans import load_spans
+    from tpu_resnet.obs.trace import ROUTE_EVENTS_FILE
+
+    router, (s0, s1), d, rid = fleet
+    router.cfg.route.slo_ms = 50.0
+    _prime_ring(router, [200.0] * 64)    # rolling p99 over the SLO
+    code, out, headers = _post(router.port, _img(1).tobytes(), "1,8,8,3",
+                               headers={"X-Lane": "batch",
+                                        "X-Trace-Id": "shed-1"})
+    assert code == 429
+    assert headers.get("X-Trace-Id") == "shed-1"
+    spans = [s for s in load_spans(os.path.join(d, ROUTE_EVENTS_FILE))
+             if s.get("span") == "route_request"
+             and s.get("trace_id") == "shed-1"]
+    assert len(spans) == 1          # always-keep: shed
+    assert spans[0]["sampled"] == "shed" and spans[0]["status"] == 429
+    assert spans[0]["decision"] == "shed"

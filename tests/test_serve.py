@@ -968,3 +968,65 @@ def test_checkpoint_backend_close_blocks_until_swap_completes(
     assert b._ckpt.closed
     # post-close reload attempts abort cleanly (no manager access)
     assert b._load(6) is False
+
+
+def test_serve_request_trace_spans_and_echo(tmp_path):
+    """Replica-side hop of a distributed trace: X-Trace-Id echoes on the
+    response, untraced requests never enter the tail sampler, errors are
+    always-keep spans, and kept spans carry the batcher's timing
+    segments (queue wait / inference / pad fraction)."""
+    from tpu_resnet.obs.spans import SpanTracer, load_spans
+    from tpu_resnet.obs.trace import SERVE_EVENTS_FILE
+
+    cfg = _serve_cfg(replica_name="r7", max_wait_ms=5.0)
+    cfg.train.train_dir = str(tmp_path)
+    spans = SpanTracer(str(tmp_path), filename=SERVE_EVENTS_FILE)
+    srv = PredictServer(cfg, backend=FakeBackend(), spans=spans).start()
+
+    def post(body, shape=None, trace=None):
+        headers = {"Content-Type": "application/octet-stream",
+                   **({"X-Shape": shape} if shape else {}),
+                   **({"X-Trace-Id": trace} if trace else {})}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predict", data=body,
+            headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+                return r.status, dict(r.headers)
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code, dict(e.headers)
+
+    try:
+        code, headers = post(_images(2, 3).tobytes(), "2,8,8,3",
+                             trace="t-ok")
+        assert code == 200 and headers.get("X-Trace-Id") == "t-ok"
+        # no client trace id -> no echo, no sampler observation (the
+        # router and loadgen are the minting authorities, not the hop)
+        code, headers = post(_images(1, 3).tobytes(), "1,8,8,3")
+        assert code == 200 and "X-Trace-Id" not in headers
+        # a traced parse error is an always-keep span class
+        code, headers = post(b"bogus", "9,9", trace="t-err")
+        assert code == 400 and headers.get("X-Trace-Id") == "t-err"
+        assert srv.sampler.stats()["observed"] == 2
+        err = [s for s in load_spans(str(tmp_path / SERVE_EVENTS_FILE))
+               if s.get("span") == "serve_request"
+               and s.get("trace_id") == "t-err"]
+        assert len(err) == 1
+        assert err[0]["sampled"] == "error" and err[0]["status"] == 400
+        assert err[0]["replica"] == "r7"
+        # past the sampler's base period a kept 200 span lands with the
+        # batcher's segment attribution
+        for i in range(60):
+            post(_images(1, i % 7).tobytes(), "1,8,8,3", trace=f"t-{i}")
+        kept = [s for s in load_spans(str(tmp_path / SERVE_EVENTS_FILE))
+                if s.get("span") == "serve_request"
+                and s.get("status") == 200]
+        assert kept, "no 200 serve_request span after 62 requests"
+        for key in ("queue_wait_ms", "infer_ms", "pad_fraction",
+                    "batch_size", "n", "latency_ms", "lane"):
+            assert key in kept[0], key
+    finally:
+        srv.batcher.drain(5.0)
+        srv.close()

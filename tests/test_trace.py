@@ -291,3 +291,78 @@ def test_h2d_transfer_lane(tmp_path):
     assert (4, "h2d-transfer") in names
     counters = {e["name"] for e in ev if e["ph"] == "C"}
     assert {"h2d_bytes_per_sec", "h2d_overlap_frac"} <= counters
+
+
+def test_request_lanes_and_fleet_lane(run_dir):
+    """Tail-sampled route_request/serve_request spans sharing a trace id
+    render as per-request lanes (slowest first, drop-counted in the
+    metadata, never silently capped), the replica span's queue/infer/
+    stall segments are synthesized inside it, and fleetmon's spans get
+    their own process lane."""
+    rid = "deadbeef1234"
+    t0 = 1_700_000_000.0
+    _write_jsonl(os.path.join(run_dir, "route_events.jsonl"), [
+        {"span": "route_request", "start": t0 + 20, "end": t0 + 20.5,
+         "pid": 444, "run_id": rid, "trace_id": "tr-slow",
+         "duration_sec": 0.5, "lane": "interactive", "status": 200,
+         "sampled": "slow", "replica": "r0", "latency_ms": 500.0,
+         "legs": [{"replicas": ["r0"], "status": 200,
+                   "answered": "r0", "ms": 499.0}]},
+        {"span": "route_request", "start": t0 + 21, "end": t0 + 21.05,
+         "pid": 444, "run_id": rid, "trace_id": "tr-fast",
+         "duration_sec": 0.05, "lane": "interactive", "status": 200,
+         "sampled": "sampled", "replica": "r1", "latency_ms": 50.0},
+    ])
+    _write_jsonl(os.path.join(run_dir, SERVE_EVENTS_FILE), [
+        {"span": "serve_warmup", "start": t0 + 19, "end": t0 + 19.5,
+         "pid": 333, "run_id": rid, "model_step": 50},
+        {"span": "serve_request", "start": t0 + 20.05,
+         "end": t0 + 20.45, "pid": 333, "run_id": rid,
+         "trace_id": "tr-slow", "duration_sec": 0.4, "status": 200,
+         "sampled": "slow", "replica": "r0", "latency_ms": 400.0,
+         "queue_wait_ms": 100.0, "infer_ms": 250.0,
+         "pad_fraction": 0.5, "batch_size": 4, "n": 1},
+    ])
+    _write_jsonl(os.path.join(run_dir, "fleet_events.jsonl"), [
+        {"span": "fleet_start", "start": t0 + 18, "end": t0 + 18,
+         "pid": 555, "run_id": rid, "slo_ms": 50.0},
+        {"span": "fleet_burn_alert", "start": t0 + 22, "end": t0 + 22,
+         "pid": 555, "run_id": rid, "burn_rate_fast": 300.0,
+         "burn_rate_slow": 120.0, "fleet_p99_ms": 420.0},
+    ])
+    trace = build_trace(run_dir)
+    assert validate_trace(trace) == []
+    meta = trace["metadata"]
+    assert meta["request_lanes"] == {"traces": 2, "rendered": 2,
+                                     "dropped": 0}
+    assert meta["source_run_ids"]["route"] == [rid]
+    assert meta["source_run_ids"]["fleet"] == [rid]
+
+    events = trace["traceEvents"]
+    lanes = {e["args"]["name"]: e for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"
+             and e["pid"] == 7000000}
+    # slowest trace is lane 1, by max span duration
+    assert lanes["req tr-slow"]["tid"] == 1
+    assert lanes["req tr-fast"]["tid"] == 2
+    proc_names = {e["args"]["name"] for e in events
+                  if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "requests (tail-sampled)" in proc_names
+    assert any(n.startswith("fleetmon") for n in proc_names)
+
+    req = [e for e in events if e.get("cat") == "request"]
+    by_name = {e["name"]: e for e in req if e["tid"] == 1}
+    assert {"route_request", "serve_request", "queue_wait", "infer",
+            "stall"} <= set(by_name)
+    # segments partition the replica span: 100ms wait + 250ms infer +
+    # 50ms unattributed stall, nested inside it on the same lane
+    assert by_name["queue_wait"]["dur"] == pytest.approx(1e5, abs=1.0)
+    assert by_name["infer"]["dur"] == pytest.approx(2.5e5, abs=1.0)
+    assert by_name["stall"]["dur"] == pytest.approx(5e4, abs=1.0)
+    assert by_name["serve_request"]["ts"] >= by_name["route_request"]["ts"]
+    assert by_name["route_request"]["args"]["trace_id"] == "tr-slow"
+    assert by_name["route_request"]["args"]["legs"][0]["answered"] == "r0"
+    # the fleet lane carries the alert instant
+    assert any(e["name"] == "fleet_burn_alert" for e in events)
+    # deterministic re-export with request lanes present
+    assert build_trace(run_dir) == trace

@@ -117,8 +117,11 @@ class ClientStats:
     """Per-client tally merged at the end (no cross-thread locking in the
     request path)."""
 
-    def __init__(self, lane: str = "interactive"):
+    SLOWEST_K = 8
+
+    def __init__(self, lane: str = "interactive", client_id: int = 0):
         self.lane = lane
+        self.client_id = client_id
         self.latencies_ms = []
         self.ok = 0
         self.rejected = 0          # 429 backpressure / shed
@@ -126,16 +129,38 @@ class ClientStats:
         self.timeouts = 0          # blew the per-request deadline
         self.connect_failures = 0  # refused / reset / unreachable
         self.images = 0
+        self.seq = 0
+        self.slowest = []          # (latency_ms, trace_id) worst-K heap
+
+    def mint_trace(self) -> str:
+        """Client-side trace id, stamped on the request as X-Trace-Id so
+        the router/replica span lanes and this client's latency tally
+        name the same request. Deterministic per (client, seq) — rerun
+        the same seed and the ids line up."""
+        self.seq += 1
+        return f"lg{self.client_id:x}-{self.seq:x}"
+
+    def note_trace(self, trace_id: str, dt_ms: float) -> None:
+        """Track the worst-K requests this client saw (timeouts count —
+        they ARE the tail). Merged and reported as
+        ``slowest_traces`` in RESULT_JSON: the ids to grep for in
+        ``trace-export``'s request lanes."""
+        self.slowest.append((dt_ms, trace_id))
+        if len(self.slowest) > self.SLOWEST_K:
+            self.slowest.sort(reverse=True)
+            del self.slowest[self.SLOWEST_K:]
 
 
 def _fire(url: str, body: bytes, shape: str, timeout: float,
-          lane: str = "interactive") -> int:
+          lane: str = "interactive", trace_id: str = "") -> int:
     """One predict. Returns the HTTP status, -2 for a client-side
     timeout, -1 for a connect failure."""
     headers = {"Content-Type": "application/octet-stream",
                "X-Shape": shape}
     if lane != "interactive":
         headers["X-Lane"] = lane
+    if trace_id:
+        headers["X-Trace-Id"] = trace_id
     req = urllib.request.Request(url + "/predict", data=body,
                                  headers=headers)
     try:
@@ -191,8 +216,12 @@ def _client_loop(url: str, images: np.ndarray, t_start: float,
                                     / duration), 1e-3)
             next_at += interval / factor
         t0 = time.monotonic()
-        status = _fire(url, body, shape, timeout, lane=stats.lane)
-        _note(stats, status, n, (time.monotonic() - t0) * 1e3)
+        trace_id = stats.mint_trace()
+        status = _fire(url, body, shape, timeout, lane=stats.lane,
+                       trace_id=trace_id)
+        dt_ms = (time.monotonic() - t0) * 1e3
+        _note(stats, status, n, dt_ms)
+        stats.note_trace(trace_id, dt_ms)
 
 
 def _slow_client_loop(host: str, port: int, body: bytes, shape: str,
@@ -201,13 +230,15 @@ def _slow_client_loop(host: str, port: int, body: bytes, shape: str,
     """A byte-trickling client: sends the request body in delayed chunks
     over a raw socket, holding a server handler thread open the whole
     time — the classic slowloris-shaped tenant a fleet must tolerate."""
-    head = (f"POST /predict HTTP/1.1\r\nHost: {host}\r\n"
-            f"Content-Type: application/octet-stream\r\n"
-            f"X-Shape: {shape}\r\nContent-Length: {len(body)}\r\n"
-            f"Connection: close\r\n\r\n").encode()
     step = max(1, len(body) // 8)
     while time.monotonic() < deadline:
         t0 = time.monotonic()
+        trace_id = stats.mint_trace()
+        head = (f"POST /predict HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Type: application/octet-stream\r\n"
+                f"X-Shape: {shape}\r\nX-Trace-Id: {trace_id}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: close\r\n\r\n").encode()
         try:
             with socket.create_connection((host, port), timeout=10) as s:
                 s.sendall(head)
@@ -225,8 +256,9 @@ def _slow_client_loop(host: str, port: int, body: bytes, shape: str,
                     resp += chunk
                 status_line = resp.split(b"\r\n", 1)[0].split()
                 status = int(status_line[1]) if len(status_line) > 1 else 0
-                _note(stats, status if status else -1, 1,
-                      (time.monotonic() - t0) * 1e3)
+                dt_ms = (time.monotonic() - t0) * 1e3
+                _note(stats, status if status else -1, 1, dt_ms)
+                stats.note_trace(trace_id, dt_ms)
         except TimeoutError:
             stats.timeouts += 1
         except (OSError, ValueError, IndexError):
@@ -340,7 +372,7 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
     for i in range(clients):
         lane = ("batch" if scenario == "mixed_lane" and i % 2
                 else "interactive")
-        st = ClientStats(lane=lane)
+        st = ClientStats(lane=lane, client_id=i)
         stats.append(st)
         images = rng.randint(0, 255, (images_per_request, h, w, c)
                              ).astype(np.uint8)
@@ -357,8 +389,8 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
         host = url.split("://", 1)[-1].rsplit(":", 1)[0]
         port = int(url.rsplit(":", 1)[-1])
         body = rng.randint(0, 255, (1, h, w, c)).astype(np.uint8).tobytes()
-        for _ in range(max(1, slow_clients)):
-            st = ClientStats(lane="slow")
+        for j in range(max(1, slow_clients)):
+            st = ClientStats(lane="slow", client_id=clients + j)
             slow_stats.append(st)
             threads.append(threading.Thread(
                 target=_slow_client_loop,
@@ -416,6 +448,14 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
             "backend": "serve", "steps_per_sec": throughput,
         }],
         "backend": "serve",
+        # Worst requests by client-observed latency, by the trace ids
+        # this client stamped — paste one into trace-export's request
+        # lanes to see where that exact request spent its time.
+        "slowest_traces": [
+            {"trace_id": tid, "latency_ms": round(ms, 2)}
+            for ms, tid in sorted(
+                (p for st in stats + slow_stats for p in st.slowest),
+                reverse=True)[:5]],
         "server": {
             "model_step": info.get("model_step"),
             "observed_mean_batch": round(

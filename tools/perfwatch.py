@@ -78,11 +78,13 @@ METRICS = (
 LOWER_IS_BETTER = {"imagenet_hbm_peak_bytes"}
 SWEEP_MEM_PREFIX = "sweep-mem:"
 SWEEP_TTR_PREFIX = "sweep-ttr:"
+SWEEP_LAT_PREFIX = "sweep-lat:"
 
 
 def _lower_is_better(name: str) -> bool:
     return (name in LOWER_IS_BETTER
-            or name.startswith((SWEEP_MEM_PREFIX, SWEEP_TTR_PREFIX)))
+            or name.startswith((SWEEP_MEM_PREFIX, SWEEP_TTR_PREFIX,
+                                SWEEP_LAT_PREFIX)))
 
 
 def salvage_result(text: str) -> Optional[dict]:
@@ -321,6 +323,17 @@ def load_sweep_samples(paths: List[str]) -> List[dict]:
                     "metric": f"{SWEEP_TTR_PREFIX}{point.get('id')}",
                     "backend": backend,
                     "value": float(ttr), "partial": False})
+            # Serving-latency twin (lower-is-better): fleetmon's merged
+            # fleet p99 and burn-rate series from the doctor probe — a
+            # latency regression across probe runs gates exactly like a
+            # throughput one.
+            lat = point.get("latency_ms")
+            if isinstance(lat, (int, float)) and lat > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"{SWEEP_LAT_PREFIX}{point.get('id')}",
+                    "backend": backend,
+                    "value": float(lat), "partial": False})
     return samples
 
 

@@ -136,7 +136,11 @@ REGISTRY_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 # jax-aware layer by design.
 HOST_ONLY_FILES = ("tpu_resnet/serve/router.py",
                    "tpu_resnet/serve/batcher.py",
-                   "tpu_resnet/serve/discovery.py")
+                   "tpu_resnet/serve/discovery.py",
+                   # The fleet aggregator is the control-plane sensor:
+                   # it must keep scraping while the data plane's
+                   # accelerator stack is the thing that is broken.
+                   "tpu_resnet/obs/fleet.py")
 
 HOST_SYNC_EXACT = {
     "print": "host I/O",

@@ -521,6 +521,45 @@ class RouteConfig:
 
 
 @dataclasses.dataclass
+class FleetConfig:
+    """Fleet telemetry aggregator (tpu_resnet/obs/fleet.py;
+    docs/OBSERVABILITY.md "Fleet"). ``fleetmon`` is a jax-free
+    control-plane process that discovers every serving/telemetry
+    endpoint from the discovery files in one directory, scrapes all
+    /metrics on an interval into an append-only on-disk timeseries,
+    merges per-replica latency histograms bucket-wise into true fleet
+    percentiles, and tracks SLO error-budget burn rates — the sensor a
+    future autoscaler reads."""
+
+    # fleetmon's own HTTP port: 0 = OS-assigned ephemeral (recorded in
+    # <discover_dir>/fleetmon.json), >0 fixed, <0 disabled.
+    port: int = 0
+    host: str = "0.0.0.0"
+    # Directory scanned for serve*.json / route.json / telemetry*.json
+    # announcements. "" = train.train_dir (the colocated default).
+    discover_dir: str = ""
+    # Scrape cadence and per-endpoint timeout.
+    scrape_interval_secs: float = 2.0
+    scrape_timeout_secs: float = 2.0
+    # Fleet latency SLO: requests slower than slo_ms spend error budget.
+    # 0 disables burn tracking (scraping/merging still runs).
+    slo_ms: float = 0.0
+    # Fraction of requests that must meet the SLO (0.999 = 0.1% budget).
+    slo_target: float = 0.999
+    # Multiwindow burn-rate alerting (the SRE-workbook shape): the alert
+    # fires only when BOTH windows burn hot — the fast window catches
+    # the spike, the slow window keeps a transient blip from paging.
+    fast_window_secs: float = 60.0
+    slow_window_secs: float = 600.0
+    burn_alert_fast: float = 14.0
+    burn_alert_slow: float = 6.0
+    # Scrape rounds kept in memory for windowed burn math (the on-disk
+    # timeseries is unbounded/append-only; this ring only needs to span
+    # slow_window_secs of rounds).
+    ring: int = 4096
+
+
+@dataclasses.dataclass
 class ProgramsConfig:
     """Compiled-program registry (tpu_resnet/programs/registry.py;
     docs/PERF.md "Cold start"). One owner for the canonical program-key
@@ -556,6 +595,7 @@ class RunConfig:
         default_factory=ResilienceConfig)
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     route: RouteConfig = dataclasses.field(default_factory=RouteConfig)
+    fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
     programs: ProgramsConfig = dataclasses.field(
         default_factory=ProgramsConfig)
 

@@ -53,6 +53,12 @@ def main(argv=None):
         ("route", "serving-fleet front router: spread /predict over N "
                   "serve replicas with health-probed failover, SLO-aware "
                   "load shedding and rolling drains (docs/SERVING.md)"),
+        ("fleetmon", "fleet telemetry aggregator: discover every "
+                     "serve/route/train endpoint in a dir, scrape all "
+                     "/metrics on an interval into an on-disk "
+                     "timeseries, merge per-replica latency histograms "
+                     "into true fleet p50/p95/p99, page on SLO "
+                     "error-budget burn (docs/OBSERVABILITY.md)"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("trace-export", "merge a run's spans/metrics/eval/serve events "
@@ -192,6 +198,16 @@ def main(argv=None):
                                 "replicated twin's, SIGTERM + exact-step "
                                 "resume under zero1, perfwatch peak-HBM "
                                 "ingestion")
+            p.add_argument("--fleetmon-probe", action="store_true",
+                           help="fleet-observability drill (~2min "
+                                "scrubbed CPU): 2 replicas + router + "
+                                "fleetmon, one replica fault-slowed -> "
+                                "zero failed requests, traced requests "
+                                "attribute the tail to the slow "
+                                "replica's inference segment, fleet-"
+                                "merged p99 > healthy replica's own "
+                                "p99, burn-rate alert span fires, "
+                                "perfwatch ingests fleet latency")
             p.add_argument("--reshape-drill", action="store_true",
                            help="elastic-capacity drill (~2min tiny CPU "
                                 "runs): mesh8 train preempted by an "
@@ -222,6 +238,7 @@ def main(argv=None):
                              serve_probe=args.serve_probe,
                              coldstart_probe=args.coldstart_probe,
                              fleet_probe=args.fleet_probe,
+                             fleetmon_probe=args.fleetmon_probe,
                              trace_probe=args.trace_probe,
                              perfwatch=args.perfwatch,
                              sweep_probe=args.sweep_probe,
@@ -316,6 +333,13 @@ def main(argv=None):
             print(json.dumps(result))
             return 0 if result.get("ok") else 1
         return route(cfg)
+
+    if args.command == "fleetmon":
+        # Control-plane sensor, same host-isolation contract as the
+        # router: stdlib-only scraping, no parallel.initialize() — it
+        # must keep reporting while the data plane is on fire.
+        from tpu_resnet.obs.fleet import fleetmon
+        return fleetmon(cfg)
 
     if args.command == "inspect":
         from tpu_resnet.tools.inspect_ckpt import main as inspect_main

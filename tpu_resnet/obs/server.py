@@ -184,6 +184,38 @@ ROUTE_GAUGES = (
     ("route_lane_batch_total", "Batch-lane requests routed"),
 )
 
+# Fleet-aggregator gauge set (tpu_resnet/obs/fleet.py; docs/
+# OBSERVABILITY.md "Fleet"). fleetmon runs the same registry/HTTP stack
+# on its own port; the fleet_serve_p* series are EXACT pooled quantiles
+# from bucket-wise histogram merges (merge_histograms), never an
+# average of per-replica percentiles.
+FLEET_GAUGES = (
+    ("fleet_endpoints_total", "Endpoints found in the discovery dir on "
+                              "the last scrape round"),
+    ("fleet_endpoints_up", "Endpoints whose /metrics answered on the "
+                           "last round"),
+    ("fleet_scrapes_total", "Scrape rounds completed since start"),
+    ("fleet_scrape_errors_total", "Individual endpoint scrapes that "
+                                  "failed (cumulative)"),
+    ("fleet_requests_total", "Requests admitted across all serve "
+                             "replicas (summed serve_latency_ms count)"),
+    ("fleet_serve_p50_ms", "Fleet-wide p50 predict latency (bucket-"
+                           "merged across replicas)"),
+    ("fleet_serve_p95_ms", "Fleet-wide p95 predict latency (bucket-"
+                           "merged across replicas)"),
+    ("fleet_serve_p99_ms", "Fleet-wide p99 predict latency (bucket-"
+                           "merged across replicas)"),
+    ("fleet_slo_ms", "Configured fleet latency SLO threshold (0 = burn "
+                     "tracking off)"),
+    ("fleet_burn_rate_fast", "Error-budget burn rate over the fast "
+                             "window (1.0 = burning exactly the "
+                             "budget)"),
+    ("fleet_burn_rate_slow", "Error-budget burn rate over the slow "
+                             "window"),
+    ("fleet_alerts_total", "Burn-rate alerts fired since start"),
+    ("fleet_alert_active", "1 while a burn-rate alert condition holds"),
+)
+
 
 # Histogram bucket edges (upper bounds; +Inf is implicit). Latencies in
 # ms span sub-ms CPU inference to multi-second stragglers; the fraction
@@ -325,6 +357,38 @@ def histogram_quantile(hist: dict, q: float) -> float:
             return float(prev_edge + (edge - prev_edge) * frac)
         prev_edge, prev_cum = edge, cum
     return float(prev_edge)
+
+
+def merge_histograms(snapshots) -> dict:
+    """Bucket-wise merge of histogram snapshots from different processes
+    into one pooled snapshot.
+
+    Because every replica uses the same fixed bucket edges (the PR 6
+    pre-declared exposition), summing cumulative counts position-wise is
+    EXACT pooling: ``histogram_quantile`` over the merge equals the
+    quantile of the pooled samples to within one bucket's interpolation
+    error — the true fleet p99, not an average of per-replica
+    percentiles (tests/test_fleet.py proves the equivalence vs numpy).
+
+    Mismatched bucket boundaries raise ValueError — merging histograms
+    with different edges silently would fabricate counts in buckets that
+    never existed. Empty input merges to an empty snapshot."""
+    snapshots = [s for s in snapshots if s and s.get("buckets")]
+    if not snapshots:
+        return {"buckets": [], "sum": 0.0, "count": 0}
+    edges = [e for e, _ in snapshots[0]["buckets"]]
+    for s in snapshots[1:]:
+        other = [e for e, _ in s["buckets"]]
+        if other != edges:
+            raise ValueError(
+                f"cannot merge histograms with mismatched bucket edges: "
+                f"{edges} vs {other}")
+    buckets = []
+    for i, edge in enumerate(edges):
+        buckets.append((edge, sum(s["buckets"][i][1] for s in snapshots)))
+    return {"buckets": buckets,
+            "sum": sum(float(s.get("sum", 0.0)) for s in snapshots),
+            "count": sum(int(s.get("count", 0)) for s in snapshots)}
 
 
 class TelemetryRegistry:

@@ -23,8 +23,10 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from contextlib import contextmanager
+from typing import Optional
 
 log = logging.getLogger("tpu_resnet")
 
@@ -87,6 +89,97 @@ class SpanTracer:
                 f.close()
             except OSError:  # pragma: no cover - fs-specific
                 pass
+
+
+class TailSampler:
+    """Tail-based retention decision for per-request tracing spans.
+
+    Recording every request as a span would make the event log grow
+    linearly with traffic — useless at fleet rates and a disk hazard on
+    a long-lived replica. The sampler keeps exactly the traces an
+    operator pulls up after an incident:
+
+    * every error / shed / retried / hedged request (always kept),
+    * everything slower than a rolling latency quantile ("the slowest
+      percentile" — the p99 excursions the fleet plane exists to
+      explain),
+    * plus a thinning baseline sample of healthy traffic whose period
+      doubles as volume accumulates, so steady-state kept-span volume is
+      O(log N) in request count — sublinear by construction (asserted in
+      tests/test_fleet.py).
+
+    ``observe()`` returns the keep *reason* (stamped on the span as the
+    ``sampled`` attr so readers know why a trace exists) or ``None`` to
+    drop. Pure in-memory decision under its own lock; callers write the
+    span *outside* any lock, keeping the concurrency engine's
+    blocking-under-lock rule clean.
+    """
+
+    ALWAYS_KEEP = ("error", "shed", "retry", "hedge")
+
+    def __init__(self, quantile: float = 0.95, base_period: int = 50,
+                 ring: int = 512, min_samples: int = 100):
+        self.quantile = float(quantile)
+        self._lock = threading.Lock()
+        self._ring = [0.0] * int(ring)
+        self._n = 0                     # total observations
+        self._kept_baseline = 0         # baseline keeps since last doubling
+        self._period = int(base_period)
+        self._since_sample = 0          # observations since last baseline keep
+        self._threshold = None          # cached rolling quantile
+        self._min_samples = int(min_samples)
+        self._kept = 0
+
+    def _slow_threshold(self) -> Optional[float]:
+        """Rolling nearest-rank quantile over the latency ring, recomputed
+        lazily every ~100 observations (sorting 512 floats per request
+        would be hot-path noise)."""
+        if self._n < self._min_samples:
+            return None
+        if self._threshold is None or self._n % 100 == 0:
+            vals = sorted(self._ring[:min(self._n, len(self._ring))])
+            idx = min(len(vals) - 1,
+                      max(0, int(self.quantile * len(vals) + 0.5) - 1))
+            self._threshold = vals[idx]
+        return self._threshold
+
+    def observe(self, latency_ms: float, error: bool = False,
+                shed: bool = False, retried: bool = False,
+                hedged: bool = False) -> Optional[str]:
+        """Record one request; return the keep reason or None (drop)."""
+        with self._lock:
+            self._ring[self._n % len(self._ring)] = float(latency_ms)
+            self._n += 1
+            self._since_sample += 1
+            reason = None
+            if error:
+                reason = "error"
+            elif shed:
+                reason = "shed"
+            elif retried:
+                reason = "retry"
+            elif hedged:
+                reason = "hedge"
+            else:
+                thr = self._slow_threshold()
+                if thr is not None and latency_ms > thr:
+                    reason = "slow"
+                elif self._since_sample >= self._period:
+                    reason = "sampled"
+                    self._since_sample = 0
+                    self._kept_baseline += 1
+                    if self._kept_baseline >= 64:
+                        self._kept_baseline = 0
+                        self._period *= 2
+            if reason is not None:
+                self._kept += 1
+            return reason
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"observed": self._n, "kept": self._kept,
+                    "period": self._period,
+                    "slow_threshold_ms": self._threshold}
 
 
 def load_jsonl(path: str, require_key: str):

@@ -29,6 +29,17 @@ Lanes (Chrome trace "processes"/"threads"):
 - **router** (``route_events.jsonl``): the serving fleet's front router
   (serve/router.py) — replica up/down transitions, drain spans, shed
   events, laid beside the replica lanes they caused.
+- **fleetmon** (``fleet_events.jsonl``): the fleet telemetry aggregator
+  (obs/fleet.py) — scrape rounds and SLO burn-rate alert events.
+- **requests** (synthetic process): per-request distributed-trace lanes
+  — one thread per tail-sampled trace id, holding the router's
+  ``route_request`` span (per-leg attribution in args) with the
+  replica's ``serve_request`` span nested inside it by containment,
+  itself broken into ``queue_wait`` / ``infer`` / ``stall`` segments
+  from the batcher's timing attrs. The slowest
+  :data:`_REQUEST_LANE_CAP` traces render (never a silent cap — the
+  drop count lands in ``metadata.request_lanes``), answering "why was
+  THIS request slow" hop by hop.
 - **device-memory** (counter thread on the trainer lane): the live
   ``hbm_bytes_in_use``/``hbm_bytes_peak``/``hbm_utilization`` gauges the
   loop samples from ``device.memory_stats()`` at log boundaries
@@ -67,12 +78,15 @@ from tpu_resnet.obs.spans import load_jsonl, load_spans
 
 SERVE_EVENTS_FILE = "serve_events.jsonl"
 ROUTE_EVENTS_FILE = "route_events.jsonl"
+FLEET_EVENTS_FILE = "fleet_events.jsonl"
 TRACE_FILE = "trace.json"
 
 # Synthetic lane ids used when a source file predates pid stamping.
-_FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3, "route": 4}
+_FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3, "route": 4,
+                 "fleet": 5}
 # Thread ids within a lane (Chrome traces key threads by (pid, tid)).
-_TID_SPANS = {"train": 1, "eval": 11, "serve": 21, "route": 31}
+_TID_SPANS = {"train": 1, "eval": 11, "serve": 21, "route": 31,
+              "fleet": 41}
 _TID_BREAKDOWN = 2
 _TID_ENGINE = 3
 # Dedicated transfer lane: h2d_transfer spans (the double-buffered
@@ -91,6 +105,11 @@ _TID_MEMORY = 5
 _DEVICE_TRACE_PID_BASE = 9000000
 _DEVICE_TRACE_EVENT_CAP = 200000
 _PROFILER_SPAN = "profiler_trace"
+# Per-request distributed-trace lanes: a synthetic process well below
+# the device-trace pid space, one thread per tail-sampled trace id.
+_REQUEST_PID = 7000000
+_REQUEST_LANE_CAP = 100
+_REQUEST_SPANS = ("route_request", "serve_request")
 
 # Counter series lifted from metrics.jsonl records onto counter threads:
 # (record key, counter thread, counter name).
@@ -187,6 +206,87 @@ def _metrics_events(records: List[dict], base: float, pid: int
                 "dur": round((wall - prev) * 1e6, 1), "args": args})
         prev = wall
     return events
+
+
+def _serve_segments(s: dict, start: float, end: float, tid: int,
+                    base: float) -> List[dict]:
+    """Break one ``serve_request`` span into nested timing segments from
+    the batcher-stamped attrs: ``queue_wait`` (enqueue → batch formed),
+    ``infer`` (batch dispatch → logits), and ``stall`` — the unaccounted
+    remainder (hot-reload stalls, HTTP/parse overhead). Segments are
+    clamped inside the parent span so containment nesting holds."""
+    segs: List[dict] = []
+    cursor = start
+
+    def push(name: str, dur_ms) -> None:
+        nonlocal cursor
+        if not isinstance(dur_ms, (int, float)) or dur_ms <= 0:
+            return
+        seg_end = min(end, cursor + float(dur_ms) / 1e3)
+        if seg_end <= cursor:
+            return
+        segs.append({"name": name, "cat": "request", "ph": "X",
+                     "pid": _REQUEST_PID, "tid": tid,
+                     "ts": _us(cursor, base),
+                     "dur": round((seg_end - cursor) * 1e6, 1),
+                     "args": {}})
+        cursor = seg_end
+
+    push("queue_wait", s.get("queue_wait_ms"))
+    push("infer", s.get("infer_ms"))
+    push("stall", (end - cursor) * 1e3)
+    return segs
+
+
+def _request_lane_events(sources: Dict[str, List[dict]], base: float
+                         ) -> Tuple[List[dict], Optional[dict]]:
+    """Per-request lanes from the tail-sampled route_request /
+    serve_request spans: group by trace id, render the slowest
+    :data:`_REQUEST_LANE_CAP` traces one thread each (router span with
+    the replica span nested inside by containment), report any drop in
+    the returned info dict (never a silent cap)."""
+    traced: Dict[str, List[dict]] = {}
+    for src in ("route", "serve"):
+        for s in sources.get(src, []):
+            if s.get("span") not in _REQUEST_SPANS or not s.get("trace_id"):
+                continue
+            try:
+                float(s["start"]), float(s["end"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            traced.setdefault(str(s["trace_id"]), []).append(s)
+    if not traced:
+        return [], None
+
+    def cost(key: str) -> float:
+        return max(float(s.get("duration_sec") or 0.0)
+                   for s in traced[key])
+
+    order = sorted(traced, key=lambda k: (-cost(k), k))
+    keep = order[:_REQUEST_LANE_CAP]
+    events = [_meta("process_name", _REQUEST_PID,
+                    label="requests (tail-sampled)")]
+    for tid, key in enumerate(keep, start=1):
+        events.append(_meta("thread_name", _REQUEST_PID, tid,
+                            f"req {key}"))
+        for s in sorted(traced[key],
+                        key=lambda s: (float(s["start"]),
+                                       str(s.get("span")))):
+            start, end = float(s["start"]), float(s["end"])
+            if end < start:
+                continue
+            args = {k: v for k, v in s.items()
+                    if k not in ("span", "start", "end", "pid")}
+            events.append({"name": str(s["span"]), "cat": "request",
+                           "ph": "X", "pid": _REQUEST_PID, "tid": tid,
+                           "ts": _us(start, base),
+                           "dur": round((end - start) * 1e6, 1),
+                           "args": args})
+            if s.get("span") == "serve_request":
+                events.extend(_serve_segments(s, start, end, tid, base))
+    info = {"traces": len(traced), "rendered": len(keep),
+            "dropped": len(traced) - len(keep)}
+    return events, info
 
 
 def _meta(name: str, pid: int, tid: Optional[int] = None,
@@ -361,6 +461,7 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
                                         "events.jsonl")),
         "serve": load_spans(os.path.join(train_dir, SERVE_EVENTS_FILE)),
         "route": load_spans(os.path.join(train_dir, ROUTE_EVENTS_FILE)),
+        "fleet": load_spans(os.path.join(train_dir, FLEET_EVENTS_FILE)),
     }
     metrics = load_jsonl(os.path.join(train_dir, "metrics.jsonl"), "step")
 
@@ -400,7 +501,7 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
         (ids[0] for ids in source_run_ids.values() if ids), None)
 
     labels = {"train": "trainer", "eval": "eval-sidecar",
-              "serve": "serve", "route": "router"}
+              "serve": "serve", "route": "router", "fleet": "fleetmon"}
     for src, spans in sources.items():
         if not spans and not (src == "train" and metrics):
             continue
@@ -441,6 +542,9 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
                                 "device-memory"))
         events.extend(_metrics_events(metrics, base, pid))
 
+    req_events, request_info = _request_lane_events(sources, base)
+    events.extend(req_events)
+
     device_trace_info = None
     if device_trace:
         dev_events, device_trace_info = _device_trace_events(
@@ -458,6 +562,7 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
             "run_id": run_id,
             "source_run_ids": source_run_ids,
             "base_time_unix": base,
+            **({"request_lanes": request_info} if request_info else {}),
             **({"device_trace": device_trace_info}
                if device_trace_info else {}),
         },

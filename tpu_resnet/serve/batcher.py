@@ -86,6 +86,7 @@ class PendingRequest:
     images only."""
 
     __slots__ = ("images", "n", "enqueued_at", "latency_ms",
+                 "queue_wait_ms", "infer_ms", "pad_fraction", "batch_size",
                  "_event", "_result", "_error")
 
     def __init__(self, images: np.ndarray):
@@ -93,6 +94,15 @@ class PendingRequest:
         self.n = int(images.shape[0])
         self.enqueued_at = time.monotonic()
         self.latency_ms: Optional[float] = None
+        # Per-request trace segments, filled in by _run_batch before the
+        # completion event — the replica-side timing breakdown the
+        # distributed-tracing spans (serve_request) attribute latency
+        # with: how long this request sat queued, how long its batch's
+        # inference took, and what batch it rode in.
+        self.queue_wait_ms: Optional[float] = None
+        self.infer_ms: Optional[float] = None
+        self.pad_fraction: Optional[float] = None
+        self.batch_size: Optional[int] = None
         self._event = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -287,21 +297,29 @@ class MicroBatcher:
         batch = np.zeros((bucket,) + self.image_shape, np.uint8)
         off = 0
         formed_at = time.monotonic()
+        pad = (bucket - total) / bucket
         for r in reqs:
             batch[off:off + r.n] = r.images
             off += r.n
-            self._observe_safe("queue_wait_ms",
-                               (formed_at - r.enqueued_at) * 1e3)
-        self._observe_safe("pad_fraction", (bucket - total) / bucket)
+            r.queue_wait_ms = (formed_at - r.enqueued_at) * 1e3
+            r.pad_fraction = pad
+            r.batch_size = total
+            self._observe_safe("queue_wait_ms", r.queue_wait_ms)
+        self._observe_safe("pad_fraction", pad)
         try:
             logits = np.asarray(self._infer(batch))
         except Exception as e:  # noqa: BLE001 - per-batch failure domain
+            infer_ms = (time.monotonic() - formed_at) * 1e3
             with self._lock:
                 self._counters["failed"] += len(reqs)
                 self._counters["batches"] += 1
             for r in reqs:
+                r.infer_ms = infer_ms
                 r.set_error(e)
             return
+        infer_ms = (time.monotonic() - formed_at) * 1e3
+        for r in reqs:
+            r.infer_ms = infer_ms
         off = 0
         for r in reqs:
             r.set_result(logits[off:off + r.n])

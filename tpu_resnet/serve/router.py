@@ -56,6 +56,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -63,7 +64,7 @@ from tpu_resnet.config import RunConfig
 from tpu_resnet.obs.manifest import read_run_id
 from tpu_resnet.obs.server import (ROUTE_GAUGES, ROUTE_HISTOGRAMS,
                                    TelemetryRegistry)
-from tpu_resnet.obs.spans import SpanTracer
+from tpu_resnet.obs.spans import SpanTracer, TailSampler
 from tpu_resnet.obs.trace import ROUTE_EVENTS_FILE
 from tpu_resnet.serve.batcher import LANES, percentile
 
@@ -71,7 +72,9 @@ log = logging.getLogger("tpu_resnet")
 
 ROUTE_DISCOVERY = "route.json"
 # Headers forwarded upstream verbatim; everything else is router-local.
-_FORWARD_HEADERS = ("Content-Type", "X-Shape", "X-Lane")
+# X-Trace-Id rides every leg (forward, retry, hedge) so the replica's
+# serve_request span joins the router's route_request span under one id.
+_FORWARD_HEADERS = ("Content-Type", "X-Shape", "X-Lane", "X-Trace-Id")
 # Below this remaining budget a retry/hedge cannot plausibly complete —
 # answer 504 instead of burning a replica slot on a doomed attempt.
 _MIN_ATTEMPT_SEC = 0.005
@@ -238,6 +241,10 @@ class Router:
         self.spans = spans if spans is not None else SpanTracer(
             spans_dir, filename=ROUTE_EVENTS_FILE, run_id=self.run_id,
             enabled=bool(spans_dir))
+        # Tail-based retention for per-request route_request spans:
+        # errors/sheds/retries/hedges always kept, the slowest percentile
+        # kept, healthy traffic thinned (docs/OBSERVABILITY.md "Fleet").
+        self.sampler = TailSampler()
 
         for i, url in enumerate(cfg.route.replicas):
             self._upsert_replica(f"r{i}", str(url), pid=None, run_id=None)
@@ -607,21 +614,68 @@ class Router:
             self._count(hedge_wins=1)
         return res[0], res[1], res[2], rep
 
+    def _trace_request(self, trace_id: str, lane: str, status: int,
+                       legs: list, t0: float, shed: bool = False,
+                       retried: bool = False, hedged: bool = False,
+                       replica: Optional[str] = None,
+                       **extra) -> None:
+        """Tail-sampled ``route_request`` span: the router's hop of a
+        distributed trace, carrying per-leg attribution (which replica
+        answered, which legs failed and how long each burned) plus the
+        admission verdict. The sampler decision is pure in-memory; the
+        span write happens here with no lock held."""
+        end = time.time()
+        latency_ms = (end - t0) * 1e3
+        reason = self.sampler.observe(latency_ms, error=(status >= 500),
+                                      shed=shed, retried=retried,
+                                      hedged=hedged)
+        if reason is None:
+            return
+        attrs = {"trace_id": trace_id, "lane": lane, "status": int(status),
+                 "sampled": reason, "latency_ms": round(latency_ms, 3)}
+        if replica:
+            attrs["replica"] = replica
+        if legs:
+            attrs["legs"] = legs
+        if retried:
+            attrs["retried"] = True
+        if hedged:
+            attrs["hedged"] = True
+        attrs.update(extra)
+        self.spans.record("route_request", t0, end, **attrs)
+
     def route_predict(self, body: bytes, headers: dict
                       ) -> Tuple[int, bytes, dict]:
         """Route one predict: shed check, then up to two attempts on
         distinct replicas under the deadline budget. Returns
-        (status, payload_bytes, response_headers)."""
+        (status, payload_bytes, response_headers).
+
+        Distributed-tracing contract (docs/OBSERVABILITY.md "Fleet"):
+        the router mints a trace id when the client didn't send one
+        (X-Trace-Id), forwards it on EVERY leg, and echoes it on every
+        response path — success, shed, drain, 5xx — so the client, the
+        router span, and each replica span all name the same request."""
         lane = (headers.get("X-Lane") or "interactive").strip().lower()
         if lane not in LANES:
             lane = "interactive"
+        trace_id = (headers.get("X-Trace-Id") or "").strip() \
+            or uuid.uuid4().hex[:16]
+        t0_wall = time.time()
         self._count(requests=1, **{f"lane_{lane}": 1})
         if not self._accepting:
+            self._trace_request(trace_id, lane, 503, [], t0_wall,
+                                decision="draining")
             return 503, json.dumps(
-                {"error": "router is draining"}).encode(), {}
+                {"error": "router is draining"}).encode(), \
+                {"X-Trace-Id": trace_id}
         shed = self._maybe_shed(lane)
         if shed is not None:
-            return 429, json.dumps(shed).encode(), {"Retry-After": "1"}
+            self._trace_request(trace_id, lane, 429, [], t0_wall,
+                                shed=True, decision="shed",
+                                p99_ms=shed.get("p99_ms"),
+                                slo_ms=shed.get("slo_ms"))
+            return 429, json.dumps(shed).encode(), \
+                {"Retry-After": "1", "X-Trace-Id": trace_id}
         try:
             deadline_ms = float(headers.get("X-Deadline-Ms") or
                                 self.cfg.route.deadline_ms)
@@ -629,8 +683,11 @@ class Router:
             deadline_ms = self.cfg.route.deadline_ms
         fwd_headers = {k: headers[k] for k in _FORWARD_HEADERS
                        if headers.get(k)}
+        fwd_headers["X-Trace-Id"] = trace_id
         t_start = self._clock()
         tried: Tuple[str, ...] = ()
+        legs: List[dict] = []
+        retried = hedged = False
         last_err = "no healthy replicas"
         for attempt in range(2):
             remaining = deadline_ms / 1e3 - (self._clock() - t_start)
@@ -640,13 +697,19 @@ class Router:
             if r is None:
                 if not tried:
                     self._count(failed=1)
+                    self._trace_request(trace_id, lane, 503, legs,
+                                        t0_wall,
+                                        decision="no_healthy_replicas")
                     return 503, json.dumps(
                         {"error": "no healthy replicas",
-                         "retryable": True}).encode(), {"Retry-After": "1"}
+                         "retryable": True}).encode(), \
+                        {"Retry-After": "1", "X-Trace-Id": trace_id}
                 break
             if attempt:
                 self._count(retries=1)
+                retried = True
             used: list = []
+            leg_t0 = self._clock()
             try:
                 status, payload, up_headers, answered = self._attempt(
                     r, body, fwd_headers, remaining, tried, used)
@@ -656,7 +719,11 @@ class Router:
                 # hedge's, not the primary's) — only the retry exclusion
                 # is left to do here.
                 tried = tried + tuple(used)
+                hedged = hedged or len(used) > 1
                 last_err = str(e)
+                legs.append({"replicas": list(used), "error":
+                             last_err[:160], "ms": round(
+                                 (self._clock() - leg_t0) * 1e3, 3)})
                 log.warning("route: attempt %d failed (%s)",
                             attempt + 1, last_err)
                 continue
@@ -667,10 +734,17 @@ class Router:
                 self._count(replica_errors=1)
                 tried = tried + tuple(used)
                 last_err = f"{r.name}: {type(e).__name__}: {e}"
+                legs.append({"replicas": list(used), "error":
+                             last_err[:160], "ms": round(
+                                 (self._clock() - leg_t0) * 1e3, 3)})
                 log.warning("route: attempt %d on %s failed (%s)",
                             attempt + 1, r.name, last_err)
                 continue
             tried = tried + tuple(used)
+            hedged = hedged or len(used) > 1
+            legs.append({"replicas": list(used), "status": int(status),
+                         "answered": answered.name, "ms": round(
+                             (self._clock() - leg_t0) * 1e3, 3)})
             if status >= 500:
                 # Charged to the replica that ANSWERED 5xx — with
                 # hedging on, that may be the hedge leg, not r.
@@ -680,23 +754,36 @@ class Router:
                 last_err = f"{answered.name}: upstream {status}"
                 continue
             answered.breaker.record_success()
-            out_headers = {"X-Replica": answered.name}
+            out_headers = {"X-Replica": answered.name,
+                           "X-Trace-Id": trace_id}
             if status == 429 and up_headers.get("Retry-After"):
                 out_headers["Retry-After"] = up_headers["Retry-After"]
             if status < 400:
                 self._count(ok=1)
                 self._record_latency((self._clock() - t_start) * 1e3)
+            self._trace_request(trace_id, lane, status, legs, t0_wall,
+                                shed=(status == 429), retried=retried,
+                                hedged=hedged, replica=answered.name,
+                                deadline_ms=deadline_ms)
             return status, payload, out_headers
         self._count(failed=1)
         elapsed_ms = (self._clock() - t_start) * 1e3
         if elapsed_ms >= deadline_ms - _MIN_ATTEMPT_SEC * 1e3:
+            self._trace_request(trace_id, lane, 504, legs, t0_wall,
+                                retried=retried, hedged=hedged,
+                                decision="deadline",
+                                deadline_ms=deadline_ms)
             return 504, json.dumps(
                 {"error": f"deadline {deadline_ms:.0f}ms exhausted "
                           f"after {elapsed_ms:.0f}ms ({last_err})",
-                 "retryable": True}).encode(), {}
+                 "retryable": True}).encode(), {"X-Trace-Id": trace_id}
+        self._trace_request(trace_id, lane, 502, legs, t0_wall,
+                            retried=retried, hedged=hedged,
+                            deadline_ms=deadline_ms)
         return 502, json.dumps(
             {"error": f"all replicas failed: {last_err}",
-             "retryable": True}).encode(), {"Retry-After": "1"}
+             "retryable": True}).encode(), \
+            {"Retry-After": "1", "X-Trace-Id": trace_id}
 
     # ----------------------------------------------------------- drain
     def drain_replica(self, name: str, kill: bool = True,

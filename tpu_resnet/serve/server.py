@@ -47,7 +47,7 @@ from tpu_resnet.obs import memory as memory_obs
 from tpu_resnet.obs.manifest import read_run_id
 from tpu_resnet.obs.server import (SERVE_GAUGES, SERVE_HISTOGRAMS,
                                    TelemetryRegistry)
-from tpu_resnet.obs.spans import SpanTracer
+from tpu_resnet.obs.spans import SpanTracer, TailSampler
 from tpu_resnet.resilience.faultinject import FaultInjector, FaultPlan
 from tpu_resnet.serve.batcher import (LANES, Draining, MicroBatcher,
                                       QueueFull, default_buckets)
@@ -146,6 +146,11 @@ class PredictServer:
         self.run_id = read_run_id(cfg.train.train_dir)
         self.spans = spans if spans is not None else SpanTracer(
             cfg.train.train_dir, enabled=False)
+        # Tail-based retention for per-request serve_request spans
+        # (docs/OBSERVABILITY.md "Fleet"): errors/sheds always kept,
+        # the slowest percentile kept, healthy traffic thinned — span
+        # volume stays sublinear in request count.
+        self.sampler = TailSampler()
         self.registry.mark_unhealthy(
             "loading: compiling bucketed batch shapes")
         self._reload_every = float(cfg.serve.reload_interval_secs)
@@ -326,10 +331,18 @@ class PredictServer:
         fit is rejected before any of its inference runs. ``lane`` is
         the QoS class: batch-lane work coalesces behind everything
         queued in the interactive lane."""
+        return self._predict_pending(images, lane, [])
+
+    def _predict_pending(self, images: np.ndarray, lane: str,
+                         pending: list) -> np.ndarray:
+        """:meth:`predict` with the submitted :class:`PendingRequest`
+        objects appended to ``pending`` — even when a wait raises — so
+        the request-tracing path can read the batcher-filled timing
+        segments (queue wait, inference, pad) off whatever completed."""
         max_b = self.batcher.max_batch
-        pending = self.batcher.submit_many(
+        pending.extend(self.batcher.submit_many(
             [images[i:i + max_b]
-             for i in range(0, images.shape[0], max_b)], lane=lane)
+             for i in range(0, images.shape[0], max_b)], lane=lane))
         return np.concatenate([p.wait(REQUEST_WAIT_SEC) for p in pending])
 
     def retry_after_secs(self) -> int:
@@ -346,20 +359,34 @@ class PredictServer:
 
     def handle_predict(self, body: bytes, content_type: str,
                        shape_header: Optional[str], want_logits: bool,
-                       lane: str = "interactive") -> Tuple[int, dict]:
+                       lane: str = "interactive",
+                       trace_id: str = "") -> Tuple[int, dict]:
         """(status, response-json) for one predict call — pure enough to
         unit test without sockets. ``lane`` comes from the X-Lane header
-        (unknown values fall back to interactive, the strict lane)."""
+        (unknown values fall back to interactive, the strict lane);
+        ``trace_id`` from X-Trace-Id (router- or client-minted) — when
+        present the call is eligible for a tail-sampled ``serve_request``
+        span carrying the replica-side timing segments."""
         if lane not in LANES:
             lane = "interactive"
         self._injector.note_serve_request()
+        t0 = time.time()
+        pending: list = []
+        status, out = self._handle_predict_inner(
+            body, content_type, shape_header, want_logits, lane, pending)
+        if trace_id:
+            self._trace_request(trace_id, lane, status, pending, t0)
+        return status, out
+
+    def _handle_predict_inner(self, body, content_type, shape_header,
+                              want_logits, lane, pending) -> Tuple[int, dict]:
         try:
             images = parse_predict_body(body, content_type, shape_header,
                                         self.image_shape)
         except ValueError as e:
             return 400, {"error": str(e)}
         try:
-            logits = self.predict(images, lane=lane)
+            logits = self._predict_pending(images, lane, pending)
         except QueueFull as e:
             return 429, {"error": str(e), "retryable": True,
                          "retry_after_secs": self.retry_after_secs()}
@@ -378,6 +405,43 @@ class PredictServer:
         if want_logits:
             out["logits"] = np.asarray(logits, np.float64).tolist()
         return 200, out
+
+    def _trace_request(self, trace_id: str, lane: str, status: int,
+                       pending: list, t0: float) -> None:
+        """Tail-sampled ``serve_request`` span: the replica's hop of a
+        distributed trace. Segments come off the PendingRequest objects
+        the batcher annotated; the sampler decision is pure in-memory
+        (no I/O under any lock — the span write happens here, outside)."""
+        end = time.time()
+        latency_ms = (end - t0) * 1e3
+        reason = self.sampler.observe(
+            latency_ms, error=(status >= 400 and status != 429),
+            shed=(status == 429))
+        if reason is None:
+            return
+        attrs = {"trace_id": trace_id, "lane": lane, "status": int(status),
+                 "sampled": reason,
+                 "replica": self.cfg.serve.replica_name or "serve",
+                 "latency_ms": round(latency_ms, 3),
+                 "model_step": int(self.backend.model_step)}
+        if pending:
+            qw = [p.queue_wait_ms for p in pending
+                  if p.queue_wait_ms is not None]
+            inf = [p.infer_ms for p in pending if p.infer_ms is not None]
+            pads = [p.pad_fraction for p in pending
+                    if p.pad_fraction is not None]
+            sizes = [p.batch_size for p in pending
+                     if p.batch_size is not None]
+            attrs["n"] = sum(p.n for p in pending)
+            if qw:
+                attrs["queue_wait_ms"] = round(max(qw), 3)
+            if inf:  # chunks ride separate batches: inference time adds
+                attrs["infer_ms"] = round(sum(inf), 3)
+            if pads:
+                attrs["pad_fraction"] = round(max(pads), 4)
+            if sizes:
+                attrs["batch_size"] = max(sizes)
+        self.spans.record("serve_request", t0, end, **attrs)
 
     def info(self) -> dict:
         stats = self.batcher.stats()
@@ -438,20 +502,26 @@ class PredictServer:
                     self._send(400, {"error": "empty body"})
                     return
                 body = self.rfile.read(length)
+                trace_id = (self.headers.get("X-Trace-Id") or "").strip()
                 code, payload = server.handle_predict(
                     body, self.headers.get("Content-Type", ""),
                     self.headers.get("X-Shape"),
                     want_logits="logits=1" in query,
                     lane=(self.headers.get("X-Lane")
-                          or "interactive").strip().lower())
-                headers = None
+                          or "interactive").strip().lower(),
+                    trace_id=trace_id)
+                headers = {}
                 if code == 429:
                     # Backpressure responses carry Retry-After so a
                     # client (or the router) backs off for one honest
                     # queue-drain instead of hammering the full queue.
-                    headers = {"Retry-After": payload.get(
-                        "retry_after_secs", 1)}
-                self._send(code, payload, extra_headers=headers)
+                    headers["Retry-After"] = payload.get(
+                        "retry_after_secs", 1)
+                if trace_id:
+                    # Echo the trace id so every hop of a distributed
+                    # trace names itself to its caller.
+                    headers["X-Trace-Id"] = trace_id
+                self._send(code, payload, extra_headers=headers or None)
 
             def log_message(self, *args):  # request logs would swamp stderr
                 pass

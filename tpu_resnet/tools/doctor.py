@@ -61,6 +61,15 @@ Checks:
              drain (replica exits 0), router SIGTERM exit 0, and a
              trace-export check that router + replica lanes landed on
              one run_id-correlated timeline (docs/SERVING.md)
+  fleetmon_probe  optional (--fleetmon-probe): fleet-observability drill
+             (tpu_resnet/obs/fleet.py) — 2 replicas (one with an
+             injected 150 ms inference fault) + router + fleetmon;
+             traced traffic must finish with zero client failures, the
+             bucket-wise fleet-merged p99 must exceed the healthy
+             replica's own p99, the SLO burn-rate alert must fire, the
+             exported request lanes must attribute the tail to the slow
+             replica's inference segment, and fleet p99 + burn rate
+             feed perfwatch as gated series (docs/OBSERVABILITY.md)
   trace_probe  optional (--trace-probe): a live observability drill —
              tiny CPU train with telemetry up, /metrics scraped MID-RUN
              until the live mfu gauge and train_step_ms histogram carry
@@ -938,6 +947,322 @@ def _check_fleet_probe(timeout: int = 420) -> dict:
                 fh.close()
 
 
+def _check_fleetmon_probe(timeout: int = 420) -> dict:
+    """Fleet-observability drill (tpu_resnet/obs/fleet.py) in
+    scrubbed-CPU subprocesses — end-to-end proof that a request-level
+    slowdown on ONE replica is attributable from the outside:
+
+    1. train a tiny MLP, start replica r0 with an injected 150 ms
+       inference fault (TPU_RESNET_FAULT_SERVE_SLOW_MS), a clean r1,
+       the front router, and ``fleetmon`` with a 50 ms SLO — wait for
+       router readiness and fleetmon's first scrape round;
+    2. drive traced traffic through the router (loadgen stamps
+       X-Trace-Id): every request must answer 200 — the slow replica
+       makes the fleet SLOW, never broken — and RESULT_JSON must name
+       the slowest trace ids;
+    3. the fleet-merged p99 (bucket-wise histogram merge across
+       replicas) must exceed the healthy replica's OWN p99 — the
+       average-of-percentiles lie this plane exists to kill — and the
+       SLO burn-rate alert must fire (fleet_alerts_total >= 1, a
+       fleet_burn_alert span on the timeline);
+    4. trace-export: request lanes rendered, the slowest traced
+       requests attribute to r0, and a slow serve_request span's
+       inference segment dominates its wall time;
+    5. fleet p99 + fast burn rate feed ``perfwatch --sweep`` as
+       lower-is-better series; fleetmon and the router exit 0 on
+       SIGTERM."""
+    import signal
+    import tempfile
+    import time
+    import urllib.error
+    import urllib.request
+
+    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
+    from tpu_resnet.obs.fleet import read_fleet_port
+    from tpu_resnet.obs.server import (histogram_quantile, parse_histograms,
+                                       parse_prometheus)
+    from tpu_resnet.obs.trace import export_trace
+    from tpu_resnet.serve.router import discover_replicas, read_route_port
+
+    ns = "tpu_resnet_"
+    with tempfile.TemporaryDirectory(prefix="tpu_resnet_fleetmon_") as d:
+        model_over = [f"train.train_dir={d}", "model.name=mlp",
+                      "data.device_resident=off", "data.transfer_stage=1"]
+        rc, out = run_scrubbed_subprocess(
+            [sys.executable, "-m", "tpu_resnet", "train",
+             "--preset", "smoke",
+             "train.train_steps=6", "train.checkpoint_every=3",
+             "train.log_every=3", "train.summary_every=6",
+             "train.image_summary_every=0",
+             "train.steps_per_call=3"] + model_over,
+            n_devices=1, timeout=timeout)
+        if rc != 0:
+            return {"ok": False, "phase": "train", "rc": rc,
+                    "tail": out.strip().splitlines()[-5:]}
+
+        procs, logs = {}, {}
+
+        def spawn(name, cmd, env_extra=None):
+            log_path = os.path.join(d, f"{name}_child.log")
+            fh = open(log_path, "w")
+            logs[name] = (log_path, fh)
+            env = scrubbed_cpu_env(1)
+            env.update(env_extra or {})
+            procs[name] = subprocess.Popen(
+                cmd, env=env, stdout=fh, stderr=subprocess.STDOUT,
+                text=True)
+            return procs[name]
+
+        def tail(name):
+            path, fh = logs[name]
+            fh.flush()
+            try:
+                with open(path) as f:
+                    return f.read().strip().splitlines()[-5:]
+            except OSError:
+                return []
+
+        def fail(phase, **extra):
+            extra.setdefault("tails", {n: tail(n) for n in procs})
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+            return {"ok": False, "phase": phase, **extra}
+
+        def get_json(url, t=2):
+            with urllib.request.urlopen(url, timeout=t) as r:
+                return json.loads(r.read())
+
+        def get_metrics(url, t=5):
+            with urllib.request.urlopen(url + "/metrics", timeout=t) as r:
+                text = r.read().decode()
+            return parse_prometheus(text), parse_histograms(text)
+
+        try:
+            # r0 carries the injected 150ms-per-batch inference fault —
+            # the "one bad machine" the whole plane must attribute.
+            for name, env_extra in (
+                    ("r0", {"TPU_RESNET_FAULT_SERVE_SLOW_MS": "150"}),
+                    ("r1", None)):
+                spawn(name, [sys.executable, "-m", "tpu_resnet", "serve",
+                             "--preset", "smoke",
+                             f"serve.replica_name={name}", "serve.port=0",
+                             "serve.max_batch=4", "serve.max_wait_ms=5",
+                             "serve.reload_interval_secs=0.5"]
+                      + model_over, env_extra=env_extra)
+            spawn("router", [sys.executable, "-m", "tpu_resnet", "route",
+                             "--preset", "smoke",
+                             f"route.discover_dir={d}", "route.port=0",
+                             "route.probe_interval_secs=0.3",
+                             "route.probe_timeout_secs=2",
+                             "route.fail_threshold=2",
+                             "route.open_secs=2"] + model_over)
+            spawn("fleetmon",
+                  [sys.executable, "-m", "tpu_resnet", "fleetmon",
+                   "--preset", "smoke", f"fleet.discover_dir={d}",
+                   "fleet.port=0", "fleet.scrape_interval_secs=0.5",
+                   "fleet.slo_ms=50"] + model_over)
+            base = fm_base = None
+            healthy = 0
+            fm_ok = False
+            deadline = time.time() + timeout / 2
+            while time.time() < deadline:
+                if any(p.poll() is not None for p in procs.values()):
+                    return fail("startup", rcs={n: p.poll()
+                                                for n, p in procs.items()})
+                if base is None:
+                    port = read_route_port(d)
+                    if port is not None:
+                        base = f"http://127.0.0.1:{port}"
+                if fm_base is None:
+                    port = read_fleet_port(d)
+                    if port is not None:
+                        fm_base = f"http://127.0.0.1:{port}"
+                try:
+                    if base is not None and healthy < 2:
+                        h = get_json(base + "/healthz")
+                        healthy = int(h.get("replicas_healthy", 0))
+                    if fm_base is not None and not fm_ok:
+                        fm_ok = bool(get_json(fm_base
+                                              + "/healthz").get("ok"))
+                except (OSError, ValueError):
+                    pass
+                if healthy >= 2 and fm_ok:
+                    break
+                time.sleep(0.3)
+            if healthy < 2 or not fm_ok:
+                return fail("readiness", replicas_healthy=healthy,
+                            fleetmon_ok=fm_ok)
+
+            # -------- traced traffic through the router. The slow
+            # replica must make the fleet SLOW, never broken: 0 hard
+            # failures is the headline gate.
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            out_json = os.path.join(d, "loadgen_fleetmon.json")
+            lg = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "loadgen.py"),
+                 "--url", base, "--clients", "6", "--duration", "10",
+                 "--deadline-ms", "30000", "--out", out_json],
+                env=scrubbed_cpu_env(1), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, timeout=timeout)
+            try:
+                with open(out_json) as f:
+                    lg_result = json.load(f)
+            except (OSError, ValueError):
+                return fail("traffic", rc=lg.returncode,
+                            lg_tail=lg.stdout.strip().splitlines()[-5:])
+            hard = (lg_result["failed"] + lg_result["timeouts"]
+                    + lg_result["connect_failures"])
+            if lg.returncode != 0 or hard or not lg_result["requests_ok"]:
+                return fail("traffic", rc=lg.returncode,
+                            result={k: lg_result.get(k) for k in
+                                    ("requests_ok", "failed", "timeouts",
+                                     "connect_failures")})
+            slowest = lg_result.get("slowest_traces") or []
+            if not slowest or not all(
+                    s.get("trace_id", "").startswith("lg")
+                    for s in slowest):
+                return fail("traffic", error="RESULT_JSON carries no "
+                            "client-minted slowest trace ids",
+                            slowest=slowest)
+
+            # -------- fleet percentiles + burn alert: poll fleetmon
+            # through a few scrape rounds.
+            fm = {}
+            alert_deadline = time.time() + 30
+            while time.time() < alert_deadline:
+                try:
+                    fm, _ = get_metrics(fm_base)
+                except (OSError, ValueError):
+                    fm = {}
+                if fm.get(ns + "fleet_alerts_total", 0) >= 1 and \
+                        fm.get(ns + "fleet_requests_total", 0) > 0:
+                    break
+                time.sleep(0.5)
+            r1_url = next(r["url"] for r in discover_replicas(d)
+                          if r["name"] == "r1")
+            _, r1_hists = get_metrics(r1_url)
+            r1_p99 = histogram_quantile(
+                r1_hists.get(ns + "serve_latency_ms", {}), 0.99)
+            fleet_p99 = fm.get(ns + "fleet_serve_p99_ms", 0.0)
+            burn_fast = fm.get(ns + "fleet_burn_rate_fast", 0.0)
+            if fm.get(ns + "fleet_alerts_total", 0) < 1:
+                return fail("burn_alert", metrics={
+                    k: v for k, v in sorted(fm.items())
+                    if k.startswith(ns + "fleet_")})
+            if not fleet_p99 > r1_p99 > 0:
+                # The merged percentile MUST see r0's slow mode that the
+                # healthy replica's own histogram cannot contain.
+                return fail("fleet_percentiles", fleet_p99_ms=fleet_p99,
+                            r1_p99_ms=r1_p99)
+
+            # -------- exit-code contract BEFORE reading the timeline,
+            # so every span writer has flushed and closed.
+            for name in ("fleetmon", "router"):
+                procs[name].send_signal(signal.SIGTERM)
+            rcs = {}
+            for name in ("fleetmon", "router"):
+                try:
+                    rcs[name] = procs[name].wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    return fail("exit", error=f"{name} ignored SIGTERM")
+            if any(rcs.values()):
+                return fail("exit", rcs=rcs)
+
+            # -------- attribution on the merged timeline.
+            try:
+                _, trace = export_trace(d)
+            except (OSError, ValueError) as e:
+                return fail("trace", error=f"{type(e).__name__}: {e}")
+            events = trace["traceEvents"]
+            names = {e["name"] for e in events}
+            need = {"route_request", "serve_request", "fleet_start",
+                    "fleet_burn_alert"}
+            if not need <= names:
+                return fail("trace", missing=sorted(need - names))
+            lanes = (trace["metadata"].get("request_lanes") or {})
+            if not lanes.get("rendered"):
+                return fail("trace", error="no request lanes rendered",
+                            request_lanes=lanes)
+            routed = [e["args"] for e in events
+                      if e["name"] == "route_request"
+                      and e.get("args", {}).get("replica")]
+            served = [e["args"] for e in events
+                      if e["name"] == "serve_request"
+                      and e.get("args", {}).get("replica")]
+            if not routed:
+                return fail("attribution",
+                            error="no replica-attributed route spans")
+            tail_spans = sorted(routed, key=lambda a:
+                                a.get("latency_ms", 0.0))[-5:]
+            slow_share = sum(1 for a in tail_spans
+                             if a["replica"] == "r0") / len(tail_spans)
+            if slow_share < 0.6:
+                return fail("attribution", error="tail traces do not "
+                            "attribute to the slowed replica",
+                            tail=tail_spans)
+            r0_served = [a for a in served if a["replica"] == "r0"
+                         and a.get("infer_ms") and a.get("latency_ms")]
+            infer_dominates = bool(r0_served) and max(
+                a["infer_ms"] / a["latency_ms"] for a in r0_served) > 0.5
+            if r0_served and not infer_dominates:
+                return fail("attribution", error="r0 inference segment "
+                            "does not dominate its request time",
+                            r0_served=r0_served[:5])
+
+            # -------- fleet p99 + burn rate as perfwatch-gated series
+            # (lower-is-better latency twins; one sample each ->
+            # insufficient_data, never regress).
+            traj = os.path.join(d, "fleetmon_traj.json")
+            with open(traj, "w") as f:
+                json.dump({"metric": "fleetmon_probe", "backend": "cpu",
+                           "points": [
+                               {"id": "fleet-p99", "status": "ok",
+                                "backend": "cpu",
+                                "latency_ms": fleet_p99},
+                               {"id": "fleet-burn-fast", "status": "ok",
+                                "backend": "cpu",
+                                "latency_ms": max(burn_fast, 1e-3)},
+                           ]}, f)
+            pw = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "perfwatch.py"),
+                 "--sweep", traj],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=60)
+            if pw.returncode != 0 or \
+                    "sweep-lat:fleet-p99" not in pw.stdout:
+                return fail("perfwatch", rc=pw.returncode,
+                            pw_tail=pw.stdout.strip().splitlines()[-5:])
+
+            return {"ok": True,
+                    "requests_ok": lg_result["requests_ok"],
+                    "client_failures": 0,
+                    "slowest_traces": slowest,
+                    "fleet_p99_ms": fleet_p99,
+                    "r1_p99_ms": round(r1_p99, 2),
+                    "burn_rate_fast": burn_fast,
+                    "alerts_total": int(
+                        fm.get(ns + "fleet_alerts_total", 0)),
+                    "tail_slow_replica_share": slow_share,
+                    "infer_segment_dominates": infer_dominates,
+                    "request_lanes": lanes,
+                    "perfwatch_ingested": True,
+                    "rcs": rcs}
+        finally:
+            for name, p in procs.items():
+                if p.poll() is None:
+                    p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+            for _, fh in logs.values():
+                fh.close()
+
+
 def _check_trace_probe(timeout: int = 300) -> dict:
     """Live observability drill (tpu_resnet/obs): tiny CPU train with the
     telemetry server up, scrape /metrics MID-RUN until the live ``mfu``
@@ -1666,7 +1991,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                data_bench_secs: float = 4.0, check: bool = False,
                check_matrix: bool = True, serve_probe: bool = False,
                coldstart_probe: bool = False,
-               fleet_probe: bool = False,
+               fleet_probe: bool = False, fleetmon_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
                partition_probe: bool = False, reshape_drill: bool = False,
@@ -1712,6 +2037,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if fleet_probe:
         summary["fleet_probe"] = _check_fleet_probe()
         emit("fleet_probe", summary["fleet_probe"])
+    if fleetmon_probe:
+        summary["fleetmon_probe"] = _check_fleetmon_probe()
+        emit("fleetmon_probe", summary["fleetmon_probe"])
     if trace_probe:
         summary["trace_probe"] = _check_trace_probe()
         emit("trace_probe", summary["trace_probe"])

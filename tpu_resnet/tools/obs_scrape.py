@@ -6,15 +6,22 @@ going" without attaching to its log file:
     python -m tpu_resnet.tools.obs_scrape --dir /tmp/run1
     python -m tpu_resnet.tools.obs_scrape --url 10.0.0.7:9200
     python -m tpu_resnet.tools.obs_scrape --dir /tmp/run1 --json
+    python -m tpu_resnet.tools.obs_scrape --fleet /tmp/run1
 
 ``--dir`` reads the port the trainer recorded in
 ``<train_dir>/telemetry.json`` (train.telemetry_port=0 binds an ephemeral
 port, so scripts can't hardcode one); ``--url`` scrapes a remote host
-directly. Stdlib-only — never imports jax, so it costs milliseconds and
-works on a machine with no accelerator stack.
+directly. ``--fleet DIR`` scrapes EVERY endpoint announced in DIR
+(serve replicas, the router, trainer telemetry — the same discovery
+``fleetmon`` runs) and prints one merged table: a row per endpoint plus
+a fleet rollup whose percentiles come from the bucket-wise histogram
+merge, not an average of per-replica percentiles. Stdlib-only — never
+imports jax, so it costs milliseconds and works on a machine with no
+accelerator stack.
 
-Exit codes: 0 healthy, 1 unreachable, 2 no telemetry.json, 3 reachable
-but stale (/healthz ok=false) — launch scripts can branch on them.
+Exit codes: 0 healthy, 1 unreachable, 2 no telemetry.json (or no
+discovery files with --fleet), 3 reachable but stale (/healthz ok=false,
+or any fleet endpoint down/stale) — launch scripts can branch on them.
 """
 
 from __future__ import annotations
@@ -68,6 +75,75 @@ def format_report(report: dict, as_json: bool = False) -> str:
     return "\n".join(lines)
 
 
+def scrape_fleet(directory: str, timeout: float = 5.0) -> dict:
+    """Scrape every endpoint announced under ``directory`` and attach
+    the bucket-wise fleet rollup. Unreachable endpoints become
+    ``{"error": ...}`` rows, not exceptions — a half-up fleet is
+    exactly when you run this."""
+    from tpu_resnet.obs.fleet import (SERVE_LATENCY_SERIES,
+                                      discover_endpoints)
+    from tpu_resnet.obs.server import merge_histograms
+
+    endpoints = discover_endpoints(directory)
+    rows = []
+    for ep in endpoints:
+        row = dict(ep)
+        try:
+            row["report"] = scrape(ep["url"], timeout=timeout)
+        except (OSError, ValueError) as e:
+            row["error"] = f"{type(e).__name__}: {e}"[:160]
+        rows.append(row)
+    serve_hists = [r["report"]["histograms"].get(SERVE_LATENCY_SERIES)
+                   for r in rows
+                   if r["kind"] == "serve" and "report" in r]
+    try:
+        merged = merge_histograms(serve_hists)
+    except ValueError as e:
+        merged = {"buckets": [], "sum": 0.0, "count": 0,
+                  "merge_error": str(e)}
+    return {"directory": directory, "endpoints": rows, "fleet": merged}
+
+
+def format_fleet_report(report: dict, as_json: bool = False) -> str:
+    from tpu_resnet.obs.fleet import SERVE_LATENCY_SERIES
+
+    if as_json:
+        return json.dumps(_strict_jsonable(report), indent=1,
+                          sort_keys=True)
+    lines = [f"fleet @ {report['directory']} — "
+             f"{len(report['endpoints'])} endpoint(s)"]
+    fmt = "  {:<7s} {:<18s} {:>6s} {:>8s} {:>9s} {:>9s} {:>9s}  {}"
+    lines.append(fmt.format("kind", "name", "port", "n", "p50_ms",
+                            "p95_ms", "p99_ms", "health"))
+    for row in report["endpoints"]:
+        if "error" in row:
+            lines.append(fmt.format(
+                row["kind"], row["name"], str(row["port"]), "-", "-",
+                "-", "-", f"DOWN ({row['error']})"))
+            continue
+        rep = row["report"]
+        h = (rep.get("histograms") or {}).get(SERVE_LATENCY_SERIES) or {}
+        qs = {q: histogram_quantile(h, q) for q in (0.50, 0.95, 0.99)}
+        health = rep.get("health", {})
+        lines.append(fmt.format(
+            row["kind"], row["name"], str(row["port"]),
+            str(h.get("count", 0)), f"{qs[0.50]:g}", f"{qs[0.95]:g}",
+            f"{qs[0.99]:g}",
+            "ok" if health.get("ok") else "STALE"))
+    merged = report["fleet"]
+    if merged.get("merge_error"):
+        lines.append(f"  fleet rollup UNAVAILABLE: "
+                     f"{merged['merge_error']}")
+    else:
+        qs = {q: histogram_quantile(merged, q)
+              for q in (0.50, 0.95, 0.99)}
+        lines.append(fmt.format(
+            "fleet", "(histogram merge)", "-",
+            str(merged.get("count", 0)), f"{qs[0.50]:g}",
+            f"{qs[0.95]:g}", f"{qs[0.99]:g}", ""))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="obs_scrape",
@@ -76,14 +152,34 @@ def main(argv=None) -> int:
                     help="train dir: port read from its telemetry.json")
     ap.add_argument("--url", default="",
                     help="host[:port] or full http URL to scrape directly")
+    ap.add_argument("--fleet", default="",
+                    help="discovery dir: scrape EVERY announced endpoint "
+                         "(serve*.json / route.json / telemetry*.json) "
+                         "and print a merged fleet table")
     ap.add_argument("--host", default="127.0.0.1",
                     help="host to combine with the --dir port")
     ap.add_argument("--timeout", type=float, default=5.0)
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report as JSON")
     args = ap.parse_args(argv)
-    if bool(args.dir) == bool(args.url):
-        ap.error("exactly one of --dir / --url is required")
+    if sum(map(bool, (args.dir, args.url, args.fleet))) != 1:
+        ap.error("exactly one of --dir / --url / --fleet is required")
+
+    if args.fleet:
+        report = scrape_fleet(args.fleet, timeout=args.timeout)
+        if not report["endpoints"]:
+            print(f"no discovery files (serve*.json / route.json / "
+                  f"telemetry*.json) under {args.fleet}",
+                  file=sys.stderr)
+            return 2
+        print(format_fleet_report(report, as_json=args.json))
+        reachable = [r for r in report["endpoints"] if "report" in r]
+        if not reachable:
+            return 1
+        all_ok = all(r["report"].get("health", {}).get("ok")
+                     for r in reachable) and \
+            len(reachable) == len(report["endpoints"])
+        return 0 if all_ok else 3
 
     if args.dir:
         port = read_telemetry_port(args.dir)
