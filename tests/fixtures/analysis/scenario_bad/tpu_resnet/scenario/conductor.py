@@ -1,0 +1,14 @@
+"""Seeded host-isolation violation for the scenario conductor. The real
+scenario package is jax-free at module scope by contract — it drills
+hosts whose accelerator stack is the thing under test, and only its
+CHILD processes may touch jax. This fixture is the anti-pattern that
+must stay flagged: a module-scope jax import in the conductor."""
+
+import os
+
+import jax  # host-isolation: the conductor must never import jax
+
+
+def conduct(data, run_dir):
+    return {"scenario": data.get("name"), "run_dir": run_dir,
+            "devices": jax.device_count(), "pid": os.getpid()}

@@ -584,3 +584,21 @@ def test_route_fixture_flags_jax_import_and_handler_teardown():
                    "time.sleep", "self.drain_replica"):
         assert hazard in sig, f"{hazard} not flagged:\n{sig}"
     assert "_handle -> _teardown_now" in sig
+
+
+def test_scenario_fixture_flags_jax_import_and_real_package_is_clean():
+    """The scenario conductor is host-isolated like the router: a
+    module-scope jax import in tpu_resnet/scenario/ must stay flagged,
+    and the real package must keep passing the same rule."""
+    found = fixture_findings("scenario_bad", "host-isolation")
+    assert len(found) == 1, found
+    assert "import of 'jax'" in found[0].message
+    assert found[0].path == "tpu_resnet/scenario/conductor.py"
+
+    from tpu_resnet.analysis.jaxlint import HOST_ONLY_FILES
+    from tpu_resnet.analysis.jaxlint import run_jaxlint as _lint
+
+    scoped = [f for f in HOST_ONLY_FILES
+              if f.startswith("tpu_resnet/scenario/")]
+    assert len(scoped) == 6, scoped
+    assert not _lint(REPO, select=["host-isolation"], files=scoped)

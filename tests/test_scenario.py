@@ -1,0 +1,354 @@
+"""Scenario conductor (tpu_resnet/scenario/): schema validation with
+named errors (`scenario validate` rc-2 contract), argv/env construction
+for child processes, template expansion, the catalog listing, and a
+golden RESULT_JSON round-trip on a jax-free cmd-only scenario. The real
+drills (scenarios/*.json) run in the slow tier — see
+tests/test_scenario_drills.py."""
+
+import importlib.util
+import io
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+from tpu_resnet.resilience import exitcodes
+from tpu_resnet.scenario import catalog, cli, spec
+from tpu_resnet.scenario.conductor import (_build_argv, _child_env,
+                                           conduct_file)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _base():
+    """Smallest well-formed scenario; tests mutate copies of it."""
+    return {
+        "name": "t", "description": "d",
+        "processes": {"p": {"kind": "cmd", "argv": ["true"]}},
+        "steps": [{"do": "run", "proc": "p", "label": "go"}],
+    }
+
+
+def _errs(data):
+    return [e["error"] for e in spec.validate_scenario(data)]
+
+
+# ----------------------------------------------------- named validation
+
+def test_root_must_be_object_and_required_fields_named():
+    assert _errs([]) == ["not_an_object"]
+    missing = spec.validate_scenario({})
+    assert all(e["error"] == "missing_field" for e in missing)
+    assert sorted(e["detail"].split("'")[1] for e in missing) == \
+        ["description", "name", "processes", "steps"]
+
+
+def test_unknown_and_mistyped_fields_are_named_with_paths():
+    data = dict(_base(), extra=1)
+    (err,) = spec.validate_scenario(data)
+    assert (err["error"], err["where"]) == ("unknown_field", "$.extra")
+    data = dict(_base(), name=3)
+    (err,) = spec.validate_scenario(data)
+    assert (err["error"], err["where"]) == ("bad_type", "$.name")
+
+
+def test_empty_processes_and_steps_rejected():
+    data = dict(_base(), processes={}, steps=[])
+    assert sorted(_errs(data)) == ["empty", "empty"]
+
+
+def test_unknown_process_kind_and_step_do():
+    data = _base()
+    data["processes"]["p"] = {"kind": "trainer"}
+    assert "unknown_kind" in _errs(data)
+    data = _base()
+    data["steps"] = [{"do": "launch", "proc": "p"}]
+    assert _errs(data) == ["unknown_step"]
+
+
+def test_step_referencing_undeclared_process_is_named():
+    data = _base()
+    data["steps"] = [{"do": "run", "proc": "ghost"}]
+    (err,) = spec.validate_scenario(data)
+    assert (err["error"], err["where"]) == ("unknown_proc",
+                                            "steps[0].proc")
+
+
+def test_fault_keys_checked_against_faultinject_contract():
+    data = _base()
+    data["processes"]["p"]["faults"] = {"SIGKILL_STEP": 1}
+    (err,) = spec.validate_scenario(data)
+    assert err["error"] == "unknown_fault"
+    assert "SIGKILL_STEP" in err["where"]
+    # every documented fault key passes
+    data["processes"]["p"]["faults"] = {k: 1 for k in spec.FAULT_KEYS}
+    assert spec.validate_scenario(data) == []
+
+
+def test_bad_expect_rc_values_are_named():
+    for bad in ("crashed", True):
+        data = _base()
+        data["steps"][0]["expect_rc"] = bad
+        assert "bad_expect_rc" in _errs(data), bad
+    data = _base()
+    data["steps"][0]["expect_rc"] = 1.5  # wrong type before rc check
+    assert _errs(data) == ["bad_type"]
+    data = _base()
+    data["steps"][0]["expect_rc"] = ["preempt", 7, "nonzero"]
+    assert spec.validate_scenario(data) == []
+
+
+def test_duplicate_step_labels_rejected():
+    data = _base()
+    data["steps"] = [{"do": "sleep", "seconds": 0, "label": "x"},
+                     {"do": "sleep", "seconds": 0, "label": "x"}]
+    (err,) = spec.validate_scenario(data)
+    assert (err["error"], err["where"]) == ("duplicate_label",
+                                            "steps[1].label")
+
+
+def test_unknown_assert_check_and_series_source():
+    data = _base()
+    data["assertions"] = [{"check": "nope"}]
+    assert _errs(data) == ["unknown_check"]
+    data = _base()
+    data["series"] = [{"source": "nope", "id": "x"}]
+    assert _errs(data) == ["unknown_source"]
+
+
+def test_load_scenario_unreadable_and_toml_gate(tmp_path):
+    _, errors = spec.load_scenario(str(tmp_path / "missing.json"))
+    assert errors[0]["error"] == "unreadable"
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    _, errors = spec.load_scenario(str(bad))
+    assert errors[0]["error"] == "unreadable"
+    assert "JSON parse failed" in errors[0]["detail"]
+    toml = tmp_path / "drill.toml"
+    toml.write_text('name = "t"\n')
+    _, errors = spec.load_scenario(str(toml))
+    if importlib.util.find_spec("tomllib") is None:
+        assert errors[0]["error"] == "toml_unsupported"
+    else:
+        assert all(e["error"] != "toml_unsupported" for e in errors)
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_validate_cli_exits_usage_error_on_malformed_file(tmp_path,
+                                                          capsys):
+    path = tmp_path / "typo.json"
+    path.write_text(json.dumps(dict(_base(), extra=1)))
+    assert cli.main(["validate", str(path)]) == exitcodes.USAGE_ERROR
+    out = capsys.readouterr().out
+    assert "INVALID" in out
+    assert "[unknown_field] $.extra" in out
+
+
+def test_validate_cli_passes_every_checked_in_scenario(capsys):
+    names = [s["name"] for s in catalog.list_scenarios()]
+    assert len(names) >= 10
+    assert cli.main(["validate"] + names) == 0
+    assert capsys.readouterr().out.count(": ok") == len(names)
+
+
+def test_run_cli_rejects_invalid_file_without_spawning(tmp_path,
+                                                       capsys):
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"name": "t"}))
+    assert cli.main(["run", str(path), "--quiet"]) == \
+        exitcodes.USAGE_ERROR
+    result = json.loads(capsys.readouterr().out.split(
+        "RESULT_JSON: ", 1)[1])
+    assert result["phase"] == "validate"
+    assert result["validation_errors"]
+
+
+def test_list_covers_scenario_files_and_legacy_probes(capsys):
+    assert cli.main(["list", "--paths"]) == 0
+    out = capsys.readouterr().out
+    for name in ("fault_drill", "serve_probe", "reshape_drill",
+                 "corrupt_ckpt_while_polling",
+                 "preempt_burst_under_fleet"):
+        assert name in out, name
+    for probe in catalog.LEGACY_PROBES:
+        assert f"tools/doctor.py --{probe.replace('_', '-')}" in out
+
+
+# ------------------------------------------- child argv/env construction
+
+def test_build_argv_cmd_is_verbatim_copy():
+    proc = {"kind": "cmd", "argv": ["echo", "hi"]}
+    argv = _build_argv(proc, REPO)
+    assert argv == ["echo", "hi"]
+    assert argv is not proc["argv"]
+
+
+def test_build_argv_train_orders_preset_overrides_args():
+    proc = {"kind": "train", "preset": "cifar_smoke",
+            "overrides": {"train.total_steps": 40,
+                          "checkpoint.enabled": True,
+                          "resilience.drain_on_sigterm": False},
+            "args": ["--workdir", "/tmp/w"]}
+    assert _build_argv(proc, REPO) == [
+        sys.executable, "-m", "tpu_resnet", "train",
+        "--preset", "cifar_smoke",
+        "train.total_steps=40", "checkpoint.enabled=true",
+        "resilience.drain_on_sigterm=false",
+        "--workdir", "/tmp/w"]
+
+
+def test_build_argv_tool_kinds_resolve_scripts():
+    assert _build_argv({"kind": "loadgen"}, REPO)[1] == \
+        os.path.join(REPO, "tools", "loadgen.py")
+    assert _build_argv({"kind": "supervise"}, REPO)[1] == \
+        os.path.join(REPO, "tools", "supervise.py")
+    assert _build_argv({"kind": "sweep"}, REPO)[1:] == \
+        ["-m", "tpu_resnet.tools.sweep"]
+
+
+def test_child_env_merges_faults_after_scrub(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "3")  # must be scrubbed
+    env = _child_env({"kind": "cmd", "argv": [], "devices": 2,
+                      "env": {"SCENARIO_FLAG": "1"},
+                      "faults": {"SIGTERM_STEP": 20,
+                                 "SERVE_DROP_REQ": 3}})
+    assert "TPU_WORKER_ID" not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "device_count=2" in env["XLA_FLAGS"]
+    assert env["SCENARIO_FLAG"] == "1"
+    # the fault schedule itself is TPU_-prefixed: it must survive the
+    # scrub because it merges afterwards
+    assert env["TPU_RESNET_FAULT_SIGTERM_STEP"] == "20"
+    assert env["TPU_RESNET_FAULT_SERVE_DROP_REQ"] == "3"
+
+
+def test_expand_templates_rewrites_only_known_placeholders():
+    data = {"a": "{run}/ckpt", "b": ["{python}", "{root}/tools"],
+            "c": {"space": '{"lr": [0.1]}', "n": 3}}
+    out = spec.expand_templates(data, "/tmp/r", "/repo")
+    assert out == {"a": "/tmp/r/ckpt",
+                   "b": [sys.executable, "/repo/tools"],
+                   "c": {"space": '{"lr": [0.1]}', "n": 3}}
+
+
+def test_resolve_rc_maps_symbolic_names_through_exitcodes():
+    assert spec.resolve_rc("done") == [exitcodes.DONE]
+    assert spec.resolve_rc("preempt") == [exitcodes.PREEMPTED]
+    assert spec.resolve_rc(["preempt", 7]) == [42, 7]
+    assert spec.resolve_rc("any") is None
+    assert spec.resolve_rc(["nonzero"]) == ["nonzero"]
+    assert (exitcodes.PREEMPTED, exitcodes.NO_CAPACITY,
+            exitcodes.DONE, exitcodes.DRAINED,
+            exitcodes.USAGE_ERROR, exitcodes.HOSTENV_TIMEOUT,
+            exitcodes.HOSTENV_SPAWN_FAILED) == (42, 3, 0, 0, 2, 124, 127)
+
+
+# --------------------------------------------- conduct(): golden result
+
+def _write_scenario(tmp_path, data):
+    path = tmp_path / f"{data['name']}.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_conduct_cmd_scenario_golden_result_round_trip(tmp_path):
+    data = {
+        "name": "golden", "description": "cmd-only golden drill",
+        "tier": "fast",
+        "processes": {
+            "writer": {"kind": "cmd", "argv": [
+                "{python}", "-c",
+                "import sys; open(sys.argv[1], 'w').write('ok')",
+                "{run}/artifact.txt"]},
+            "failer": {"kind": "cmd", "argv": [
+                "{python}", "-c", "raise SystemExit(7)"]},
+        },
+        "steps": [
+            {"do": "run", "proc": "writer", "label": "write",
+             "expect_rc": 0},
+            {"do": "run", "proc": "failer", "label": "fail_ok",
+             "expect_rc": [7, "preempt"]},
+        ],
+        "assertions": [{"check": "file_exists",
+                        "path": "{run}/artifact.txt",
+                        "label": "artifact"}],
+    }
+    assert spec.validate_scenario(data) == []
+    path = _write_scenario(tmp_path, data)
+    run_dir = str(tmp_path / "run")
+    stream = io.StringIO()
+    result = conduct_file(path, run_dir=run_dir, stream=stream)
+    assert result["ok"] is True, result
+    assert result["phase"] is None and result["error"] is None
+    assert result["rcs"] == {"writer": 0, "failer": 7}
+    assert [s["label"] for s in result["steps"]] == \
+        ["write", "fail_ok", "artifact"]
+    assert all(s["ok"] for s in result["steps"])
+    assert result["perfwatch"] == {"ran": False}  # no series declared
+    # golden round-trip: the RESULT_JSON line and the on-disk artifact
+    # are byte-for-byte the same result the call returned
+    line = [ln for ln in stream.getvalue().splitlines()
+            if ln.startswith("RESULT_JSON: ")][-1]
+    assert json.loads(line[len("RESULT_JSON: "):]) == result
+    with open(os.path.join(run_dir, "scenario_result.json")) as f:
+        assert json.load(f) == result
+
+
+def test_conduct_failure_reports_contract_and_kills_survivors(tmp_path):
+    data = {
+        "name": "failing", "description": "rc mismatch kills survivors",
+        "processes": {
+            "sleeper": {"kind": "cmd", "argv": [
+                "{python}", "-c", "import time; time.sleep(60)"]},
+            "failer": {"kind": "cmd", "argv": [
+                "{python}", "-c", "raise SystemExit(7)"]},
+        },
+        "steps": [
+            {"do": "start", "proc": "sleeper", "label": "bg"},
+            {"do": "run", "proc": "failer", "label": "boom",
+             "phase": "blast", "expect_rc": 0},
+        ],
+    }
+    path = _write_scenario(tmp_path, data)
+    result = conduct_file(path, run_dir=str(tmp_path / "run"),
+                          stream=None)
+    assert result["ok"] is False
+    assert result["phase"] == "blast"
+    failed = result["steps"][-1]
+    assert failed["label"] == "boom" and not failed["ok"]
+    assert failed["observed"]["rc"] == 7
+    assert failed["observed"]["expected_rc"] == 0
+    # survivor kill: the background sleeper must not outlive the drill
+    pid = result["steps"][0]["observed"]["pid"]
+    with pytest.raises(OSError):
+        os.kill(pid, 0)
+
+
+# ------------------------------------------------- catalog + host rules
+
+def test_catalog_lists_every_checked_in_drill_with_tier():
+    entries = {s["name"]: s for s in catalog.list_scenarios()}
+    for name in ("fault_drill", "serve_probe", "trace_probe",
+                 "mem_probe", "partition_probe", "reshape_drill",
+                 "sweep_probe", "corrupt_ckpt_while_polling",
+                 "preempt_burst_under_fleet", "reshape_during_burst"):
+        assert name in entries, name
+        assert entries[name]["tier"] in ("fast", "slow")
+        assert os.path.exists(entries[name]["path"])
+    assert catalog.scenario_path("fault_drill").endswith(
+        os.path.join("scenarios", "fault_drill.json"))
+
+
+def test_conductor_passes_the_concurrency_engine(tmp_path):
+    """The reaper thread's lock discipline is a documented contract
+    (poll outside the lock, event wakeups, join on stop) — the repo's
+    own static race detector must find nothing in the conductor."""
+    from tpu_resnet.analysis.concurrency import run_concurrency
+
+    target = tmp_path / "conductor.py"
+    shutil.copy(os.path.join(REPO, "tpu_resnet", "scenario",
+                             "conductor.py"), target)
+    assert run_concurrency(str(tmp_path)) == []

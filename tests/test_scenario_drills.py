@@ -1,0 +1,60 @@
+"""Composed chaos scenarios end-to-end (slow tier): the scenario
+conductor runs multi-process drills that no single legacy probe covered
+— faults layered across the train and serve planes in one schedule.
+The per-drill fast units live in tests/test_scenario.py; the doctor
+aliases over single-fault scenarios are covered by the existing probe
+contract tests (test_serve.py, test_trace.py, test_memory.py,
+test_doctor.py, test_resilience_drills.py)."""
+
+import pytest
+
+from tpu_resnet.scenario.catalog import scenario_path
+from tpu_resnet.scenario.conductor import conduct_file
+
+pytestmark = pytest.mark.slow
+
+
+def _steps_by_label(result):
+    return {s["label"]: s for s in result["steps"]}
+
+
+def test_corrupt_ckpt_while_polling_composed_drill(tmp_path):
+    """Corrupt the newest checkpoint while a serve replica hot-polls the
+    run dir: the resume falls back to the previous step, the replica
+    reloads past the corruption, and traffic stays green throughout."""
+    result = conduct_file(scenario_path("corrupt_ckpt_while_polling"),
+                          run_dir=str(tmp_path / "run"))
+    assert result["ok"], result
+    steps = _steps_by_label(result)
+    # restore fell back (span recorded the corrupted step) and the
+    # resume re-trained through it
+    assert steps["corrupt"]["observed"]["corrupted_step"] == 6
+    spans = steps["restore_fallback"]["observed"]["spans"]
+    assert spans and spans[-1]["step"] == 6
+    assert steps["resume"]["observed"]["run_spans"] == [[0, 6], [3, 12]]
+    # the polling replica reloaded past the corruption and kept serving
+    assert steps["hot_reload"]["observed"]["model_step"] == 12
+    assert steps["hot_reload"]["observed"]["reloads"] >= 2
+    assert steps["predict_before"]["observed"]["ok_requests"] == 3
+    assert steps["predict_after"]["observed"]["ok_requests"] == 3
+    assert result["rcs"]["serve"] == 0  # drained cleanly at teardown
+    # the declared series made it into perfwatch under the sweep-scn:
+    # prefix
+    pw = result["perfwatch"]
+    assert pw["ran"] and pw["rc"] == 0
+    assert all(pw["ingested"].values()), pw["ingested"]
+    assert any(t.startswith("sweep-scn:corrupt_ckpt_while_polling:")
+               for t in pw["ingested"])
+
+
+def test_preempt_burst_under_fleet_composed_drill(tmp_path):
+    """A preemption burst fires while a router fronts two replicas under
+    sustained load: the fleet absorbs the burst (no failed requests
+    beyond the drill's allowance) and every plane drains to rc 0."""
+    result = conduct_file(scenario_path("preempt_burst_under_fleet"),
+                          run_dir=str(tmp_path / "run"))
+    assert result["ok"], result
+    assert set(result["rcs"].values()) == {0}, result["rcs"]
+    steps = _steps_by_label(result)
+    assert steps["traffic"]["ok"]
+    assert all(s["ok"] for s in result["steps"])

@@ -79,9 +79,16 @@ LOWER_IS_BETTER = {"imagenet_hbm_peak_bytes"}
 SWEEP_MEM_PREFIX = "sweep-mem:"
 SWEEP_TTR_PREFIX = "sweep-ttr:"
 SWEEP_LAT_PREFIX = "sweep-lat:"
+# Scenario-conductor series (tpu_resnet/scenario): point ids are
+# "<scenario>:<metric>", so any declared scenario series regression-
+# gates with zero glue. Direction comes from the metric's unit suffix —
+# _ms/_bytes/_s name costs (lower is better), everything else a rate.
+SWEEP_SCN_PREFIX = "sweep-scn:"
 
 
 def _lower_is_better(name: str) -> bool:
+    if name.startswith(SWEEP_SCN_PREFIX):
+        return name.endswith(("_ms", "_bytes", "_s"))
     return (name in LOWER_IS_BETTER
             or name.startswith((SWEEP_MEM_PREFIX, SWEEP_TTR_PREFIX,
                                 SWEEP_LAT_PREFIX)))
@@ -334,6 +341,16 @@ def load_sweep_samples(paths: List[str]) -> List[dict]:
                     "metric": f"{SWEEP_LAT_PREFIX}{point.get('id')}",
                     "backend": backend,
                     "value": float(lat), "partial": False})
+            # Scenario-conductor series: the point id already carries
+            # "<scenario>:<metric>"; direction is derived from the
+            # metric's unit suffix in _lower_is_better.
+            scn = point.get("scenario_value")
+            if isinstance(scn, (int, float)) and scn > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"{SWEEP_SCN_PREFIX}{point.get('id')}",
+                    "backend": backend,
+                    "value": float(scn), "partial": False})
     return samples
 
 

@@ -64,9 +64,15 @@ import time
 
 log = logging.getLogger("tpu_resnet.supervise")
 
-# Keep in sync with tpu_resnet/resilience/shutdown.py PREEMPT_EXIT_CODE
-# (not imported: the supervisor must run without the package installed).
-DEFAULT_PREEMPT_CODE = 42
+# Canonical values live in tpu_resnet/resilience/exitcodes.py; the
+# fallback keeps the supervisor runnable on a host without the package
+# installed (its core contract — it babysits the thing that crashes).
+try:
+    from tpu_resnet.resilience import exitcodes as _exitcodes
+
+    DEFAULT_PREEMPT_CODE = _exitcodes.PREEMPTED
+except ImportError:  # standalone copy of this file, package absent
+    DEFAULT_PREEMPT_CODE = 42
 
 
 def _run_id_of(cmd) -> str:

@@ -140,7 +140,16 @@ HOST_ONLY_FILES = ("tpu_resnet/serve/router.py",
                    # The fleet aggregator is the control-plane sensor:
                    # it must keep scraping while the data plane's
                    # accelerator stack is the thing that is broken.
-                   "tpu_resnet/obs/fleet.py")
+                   "tpu_resnet/obs/fleet.py",
+                   # The scenario conductor drills hosts whose
+                   # accelerator stack is the thing under test; only
+                   # its CHILD processes may touch jax.
+                   "tpu_resnet/scenario/__init__.py",
+                   "tpu_resnet/scenario/assertions.py",
+                   "tpu_resnet/scenario/catalog.py",
+                   "tpu_resnet/scenario/cli.py",
+                   "tpu_resnet/scenario/conductor.py",
+                   "tpu_resnet/scenario/spec.py")
 
 HOST_SYNC_EXACT = {
     "print": "host I/O",

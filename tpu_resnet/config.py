@@ -341,7 +341,7 @@ class ResilienceConfig:
     # SIGTERM/SIGINT → stop at the next chunk boundary, save a final
     # checkpoint, exit with preempt_exit_code (tools/supervise.py resumes).
     graceful_shutdown: bool = True
-    preempt_exit_code: int = 42  # resilience/shutdown.py PREEMPT_EXIT_CODE
+    preempt_exit_code: int = 42  # resilience/exitcodes.py PREEMPTED
     # Non-finite loss at a log boundary (already host-synced there — zero
     # extra device syncs): roll back to the last checkpoint, advance the
     # data stream past the bad window, retry up to nan_max_retries times,
@@ -395,6 +395,11 @@ class ResilienceConfig:
     # SIGKILL this serve process at the Nth predict request (-1 off):
     # the hard replica death mid-traffic the failover drill rides.
     inject_serve_kill_at_request: int = -1
+    # Abruptly close the client connection (no HTTP response) at the Nth
+    # predict request, once (-1 off): the router↔replica connection-drop
+    # the router's retry-once failover must absorb without a client-
+    # visible failure. Env override: TPU_RESNET_FAULT_SERVE_DROP_REQ.
+    inject_serve_drop_at_request: int = -1
 
 
 @dataclasses.dataclass

@@ -39,6 +39,11 @@ def main(argv=None):
         # never imports jax, works on a machine with no backend.
         from tpu_resnet.obs.trace import main as trace_main
         return trace_main(raw[1:])
+    if raw[:1] == ["scenario"]:
+        # Same delegation: the chaos-scenario conductor is jax-free by
+        # contract — its CHILDREN are the processes that touch jax.
+        from tpu_resnet.scenario.cli import main as scenario_main
+        return scenario_main(raw[1:])
     parser = argparse.ArgumentParser(prog="tpu_resnet")
     sub = parser.add_subparsers(dest="command", required=True)
     for name, help_text in [
@@ -121,6 +126,10 @@ def main(argv=None):
             p.add_argument("--out", required=True, help="dataset directory")
             p.add_argument("--keep-archive", action="store_true")
         if name == "doctor":
+            p.add_argument("--list-probes", action="store_true",
+                           help="enumerate every scenario-backed drill "
+                                "(scenarios/*.json) and every legacy "
+                                "bespoke probe, then exit")
             p.add_argument("--check", action="store_true",
                            help="also run the static-analysis suite "
                                 "(lints + config-matrix verifier)")
@@ -225,6 +234,11 @@ def main(argv=None):
         return 0
 
     if args.command == "doctor":
+        if args.list_probes:
+            # The scenario catalog owns the probe inventory — the same
+            # listing `tpu_resnet scenario list` prints.
+            from tpu_resnet.scenario.cli import main as scenario_main
+            return scenario_main(["list", "--paths"])
         from tpu_resnet.tools.doctor import run_doctor
         if args.dataset and not args.data_dir:
             parser.error("doctor --dataset requires --data-dir")

@@ -44,29 +44,49 @@ the newest restorable checkpoint) lives in ``train/checkpoint.py``; the
 input-pipeline liveness fixes live in ``data/pipeline.py``.
 """
 
-from tpu_resnet.resilience import elastic
-from tpu_resnet.resilience.faultinject import (
-    FaultInjector,
-    FaultPlan,
-    corrupt_checkpoint,
-)
-from tpu_resnet.resilience.sentinel import DivergenceError, NaNSentinel
-from tpu_resnet.resilience.shutdown import (
-    PREEMPT_EXIT_CODE,
-    Preempted,
-    ShutdownCoordinator,
-)
-from tpu_resnet.resilience.watchdog import HangWatchdog
+# Lazy re-exports (PEP 562): ``elastic`` pulls jax at import time, and
+# the jax-free consumers of this package's contracts — the scenario
+# conductor, tools/supervise.py, the router's exit-code imports — must
+# be able to ``import tpu_resnet.resilience.exitcodes`` on a host whose
+# accelerator stack is the thing being drilled without paying (or
+# crashing on) the accelerator import. Attribute access keeps the
+# eager-import API: ``from tpu_resnet.resilience import Preempted``
+# still works everywhere it did.
+_EXPORTS = {
+    "PREEMPT_EXIT_CODE": ("tpu_resnet.resilience.shutdown",
+                          "PREEMPT_EXIT_CODE"),
+    "Preempted": ("tpu_resnet.resilience.shutdown", "Preempted"),
+    "ShutdownCoordinator": ("tpu_resnet.resilience.shutdown",
+                            "ShutdownCoordinator"),
+    "DivergenceError": ("tpu_resnet.resilience.sentinel",
+                        "DivergenceError"),
+    "NaNSentinel": ("tpu_resnet.resilience.sentinel", "NaNSentinel"),
+    "FaultInjector": ("tpu_resnet.resilience.faultinject",
+                      "FaultInjector"),
+    "FaultPlan": ("tpu_resnet.resilience.faultinject", "FaultPlan"),
+    "corrupt_checkpoint": ("tpu_resnet.resilience.faultinject",
+                           "corrupt_checkpoint"),
+    "HangWatchdog": ("tpu_resnet.resilience.watchdog", "HangWatchdog"),
+    "elastic": ("tpu_resnet.resilience.elastic", None),
+    "exitcodes": ("tpu_resnet.resilience.exitcodes", None),
+}
 
-__all__ = [
-    "PREEMPT_EXIT_CODE",
-    "DivergenceError",
-    "FaultInjector",
-    "FaultPlan",
-    "HangWatchdog",
-    "NaNSentinel",
-    "Preempted",
-    "ShutdownCoordinator",
-    "corrupt_checkpoint",
-    "elastic",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    try:
+        module_name, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    module = importlib.import_module(module_name)
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value  # cache: __getattr__ fires once per name
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

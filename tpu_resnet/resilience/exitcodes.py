@@ -1,0 +1,46 @@
+"""One registry for the repo's process exit-code contracts.
+
+Every layer of the resilience story keys decisions on exit codes — the
+trainer exits distinctly on preemption, the serve replica on a denied
+colocation admission, the supervisor restarts or stops by code, doctor
+and the scenario conductor assert on all of them. Until this module each
+caller re-hardcoded the numbers with "keep in sync" comments; now the
+numbers live here once and everyone imports them.
+
+Stdlib-only and jax-free: the supervisor, the router and the scenario
+conductor import this on hosts whose accelerator stack is the thing
+being drilled (tpu_resnet/resilience/__init__ lazy-loads its jax-aware
+submodules precisely so this import stays cheap).
+
+The codes, and why each is distinct from every shell/Python convention
+in use (0 ok, 1 crash, 2 usage, 124 timeout(1), 126/127 spawn,
+128+N killed-by-signal):
+
+``PREEMPTED`` (42)
+    Graceful preemption: SIGTERM honored, final checkpoint on disk —
+    a supervisor resumes instead of backing off (resilience/shutdown.py,
+    tools/supervise.py).
+``NO_CAPACITY`` (3)
+    Serve colocation admission denied: this host has no HBM headroom —
+    the placement layer should try another host, never restart here
+    (serve/server.py, supervise --stop-codes).
+``DONE`` / ``DRAINED`` (0)
+    A trainer's 0 means finished; a serve replica's 0 means it honored
+    a drain (rolling upgrade) — supervise --restart-clean-exits gives
+    the fleet reading.
+``USAGE_ERROR`` (2)
+    CLI contract errors (argparse convention): bad flags, and the
+    scenario validator's named schema errors.
+``HOSTENV_TIMEOUT`` (124) / ``HOSTENV_SPAWN_FAILED`` (127)
+    hostenv.run_scrubbed_subprocess's timeout(1)-compatible reporting.
+"""
+
+from __future__ import annotations
+
+PREEMPTED = 42
+NO_CAPACITY = 3
+DONE = 0
+DRAINED = 0
+USAGE_ERROR = 2
+HOSTENV_TIMEOUT = 124
+HOSTENV_SPAWN_FAILED = 127

@@ -58,6 +58,7 @@ class FaultPlan:
     serve_slow_ms: float = 0.0       # extra latency per inference batch
     serve_hang_at_request: int = -1  # accept, then hang at request K
     serve_kill_at_request: int = -1  # SIGKILL self at request K
+    serve_drop_at_request: int = -1  # close the connection at request K
 
     @property
     def active(self) -> bool:
@@ -69,14 +70,15 @@ class FaultPlan:
     @property
     def serves_faults(self) -> bool:
         return (self.serve_slow_ms > 0 or self.serve_hang_at_request >= 0
-                or self.serve_kill_at_request >= 0)
+                or self.serve_kill_at_request >= 0
+                or self.serve_drop_at_request >= 0)
 
     @classmethod
     def from_config(cls, resilience_cfg, env=None) -> "FaultPlan":
         """Config fields overridden by ``TPU_RESNET_FAULT_*`` env vars:
         NAN_STEP, STALL_STEP, STALL_SEC, SIGTERM_STEP, CORRUPT_CKPT,
         OOM_STEP, PREEMPT_BURST, PREEMPT_BURST_EVERY, SERVE_SLOW_MS,
-        SERVE_HANG_REQ, SERVE_KILL_REQ."""
+        SERVE_HANG_REQ, SERVE_KILL_REQ, SERVE_DROP_REQ."""
         env = os.environ if env is None else env
         r = resilience_cfg
 
@@ -106,6 +108,9 @@ class FaultPlan:
             serve_kill_at_request=pick("SERVE_KILL_REQ",
                                        r.inject_serve_kill_at_request,
                                        int),
+            serve_drop_at_request=pick("SERVE_DROP_REQ",
+                                       r.inject_serve_drop_at_request,
+                                       int),
         )
 
 
@@ -128,6 +133,7 @@ class FaultInjector:
         self._burst_spent = False      # caches fired >= K (no re-reads)
         self._serve_requests = 0       # predict requests admitted so far
         self._serve_hung = False
+        self._serve_dropped = False
         if plan.active:
             log.warning("FAULT INJECTION ACTIVE: %s", plan)
 
@@ -280,6 +286,24 @@ class FaultInjector:
             log.warning("injecting serve SIGKILL at request %d",
                         self._serve_requests)
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def should_drop_connection(self) -> bool:
+        """One-shot router↔replica connection drop: True exactly once,
+        for the first incoming predict request >= the planned request K.
+        The HTTP handler (serve/server.py do_POST) calls this BEFORE the
+        request is admitted (``note_serve_request`` never ticks for the
+        dropped one) and then closes the client socket with no response
+        at all — the abrupt RemoteDisconnected the router's retry-once
+        failover must absorb without a client-visible failure."""
+        if (self.plan.serve_drop_at_request < 0 or self._serve_dropped
+                or self._serve_requests + 1
+                < self.plan.serve_drop_at_request):
+            return False
+        self._serve_dropped = True
+        log.warning("injecting serve connection drop at request %d "
+                    "(no HTTP response; the client sees an abrupt "
+                    "disconnect)", self._serve_requests + 1)
+        return True
 
     def maybe_oom(self, step: int) -> None:
         """Raise a synthetic RESOURCE_EXHAUSTED at the first chunk

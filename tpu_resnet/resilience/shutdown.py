@@ -23,11 +23,13 @@ import threading
 import time
 from typing import Optional
 
+from tpu_resnet.resilience import exitcodes
+
 log = logging.getLogger("tpu_resnet")
 
-# Distinct from every shell/Python convention in use: 0 ok, 1 crash,
-# 2 usage, 124 timeout(1), 126/127 spawn, 128+N killed-by-signal.
-PREEMPT_EXIT_CODE = 42
+# Canonical value lives in resilience/exitcodes.py; re-exported here
+# because this module defined it first and callers import it from here.
+PREEMPT_EXIT_CODE = exitcodes.PREEMPTED
 
 
 class Preempted(Exception):

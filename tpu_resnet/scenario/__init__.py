@@ -1,0 +1,53 @@
+"""Declarative chaos scenarios — one conductor for every drill.
+
+The repo grew eight-plus bespoke ``doctor --*-probe/drill`` harnesses
+that each hand-rolled the same skeleton: scrubbed-CPU children,
+ephemeral ports, discovery-file waits, fault env vars, log-to-file,
+survivor kill, RESULT_JSON, perfwatch hand-off. This package inverts
+that: a scenario is a checked-in FILE (``scenarios/*.json``, TOML where
+the interpreter has ``tomllib``) declaring
+
+``processes``   trainer / serve replicas / router / loadgen /
+                supervisor / raw commands, each with preset + config
+                overrides + a fault schedule riding the
+                ``resilience/faultinject.py`` ``TPU_RESNET_FAULT_*``
+                env contract;
+``steps``       the timed script: run/start children, wait for
+                discovery-file readiness under a deadline, fire predict
+                traffic, scrape /metrics until gauges go live, SIGTERM/
+                SIGKILL, drain through the router, corrupt a
+                checkpoint, assert mid-flight;
+``assertions``  exit-code contracts (named via resilience/exitcodes),
+                span/gauge/artifact presence, loss-stream parity
+                bounds, zero-failed-request loadgen counts;
+``series``      metrics handed to tools/perfwatch.py — scenario series
+                adopt the ``sweep-scn:<scenario>:<metric>`` prefix so
+                any scenario becomes regression-gated with zero glue.
+
+The conductor (``conductor.py``) owns the shared skeleton exactly once:
+``hostenv.scrubbed_cpu_env`` children (fault env merged AFTER the scrub
+— the scrub strips ``TPU_*``), child logs to files (never pipes — a
+chatty child against a full pipe deadlocks ``wait()``), a reaper thread
+collecting exits, survivor kill on first failure, and a single
+RESULT_JSON writer. ``tools/doctor.py``'s probe flags are thin aliases
+that run these files and re-emit their historical DOCTOR_JSON shapes.
+
+Everything here is jax-free at module scope (jaxlint host-isolation
+scope): scenarios drill hosts whose accelerator stack is the thing
+being broken.
+
+CLI: ``python -m tpu_resnet scenario run|list|validate`` (cli.py).
+Authoring reference: docs/SCENARIOS.md.
+"""
+
+from tpu_resnet.scenario.catalog import (  # noqa: F401
+    LEGACY_PROBES,
+    list_scenarios,
+    scenario_path,
+    scenarios_dir,
+)
+from tpu_resnet.scenario.conductor import conduct, conduct_file  # noqa: F401
+from tpu_resnet.scenario.spec import (  # noqa: F401
+    load_scenario,
+    validate_scenario,
+)

@@ -1,0 +1,291 @@
+"""Scenario pass/fail assertion evaluators.
+
+Each checker receives the (template-expanded) assert step plus the
+running :class:`~tpu_resnet.scenario.conductor.Conductor` and returns an
+observation dict; a missed contract raises ``StepFailure`` carrying the
+same observation, so the RESULT_JSON shows WHAT was seen either way and
+the doctor adapters can rebuild their historical DOCTOR_JSON dicts from
+the observations alone.
+
+Imports of obs/* stay function-scope: those modules are stdlib at
+module scope today, but this package's jax-free contract must not hinge
+on theirs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def dotted_get(obj, dotted: str):
+    """``dotted_get({"a": {"b": 3}}, "a.b") == 3``; None on any miss."""
+    for part in dotted.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj
+
+
+def _fail(observed=None, error=None, tail=None):
+    from tpu_resnet.scenario.conductor import StepFailure
+
+    raise StepFailure(error=error, observed=observed, tail=tail)
+
+
+def _load_json(path: str, observed_key: str = "path"):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        _fail(observed={observed_key: path},
+              error=f"{os.path.basename(path)} unreadable: {e}")
+
+
+# ---------------------------------------------------------------- checks
+def _check_ckpt_step(step, conductor):
+    steps = conductor._ckpt_steps(step["dir"])
+    observed = {"ckpt_steps": steps}
+    if step["step"] not in steps:
+        _fail(observed,
+              f"no checkpoint at step {step['step']}")
+    return observed
+
+
+def _check_run_spans(step, conductor):
+    spans = conductor._run_spans(step["dir"])
+    observed = {"run_spans": spans}
+    if spans != [list(s) for s in step["spans"]]:
+        _fail(observed, "run-span history does not match")
+    return observed
+
+
+def _check_span(step, conductor):
+    from tpu_resnet.obs.spans import load_spans
+
+    path = os.path.join(step["dir"], step.get("file", "events.jsonl"))
+    spans = [s for s in load_spans(path) if s["span"] == step["name"]]
+    observed = {"spans": spans}
+    if not spans:
+        _fail(observed, f"{step['name']} span missing")
+    last = spans[-1]
+    for dotted, want in (step.get("attrs") or {}).items():
+        if dotted_get(last, dotted) != want:
+            _fail(observed,
+                  f"{step['name']} span has {dotted}="
+                  f"{dotted_get(last, dotted)!r}, wanted {want!r}")
+    return observed
+
+
+def _check_artifact_json(step, conductor):
+    data = _load_json(step["path"])
+    observed = {k: dotted_get(data, d)
+                for k, d in (step.get("collect") or {}).items()}
+    for dotted, want in (step.get("expect") or {}).items():
+        got = dotted_get(data, dotted)
+        if got != want:
+            observed["artifact"] = data
+            _fail(observed,
+                  f"{os.path.basename(step['path'])} has "
+                  f"{dotted}={got!r}, wanted {want!r}")
+    return observed
+
+
+def _loss_stream(directory: str) -> dict:
+    from tpu_resnet.obs.spans import load_jsonl
+
+    records = load_jsonl(os.path.join(directory, "metrics.jsonl"),
+                         "step")
+    return {r["step"]: r["loss"] for r in records if "loss" in r}
+
+
+def _check_loss_parity(step, conductor):
+    ref = _loss_stream(step["ref_dir"])
+    got = _loss_stream(step["dir"])
+    if not ref or set(ref) != set(got):
+        _fail({"reference_steps": sorted(ref),
+               "elastic_steps": sorted(got)},
+              "logged steps differ across the reshape")
+    tol = float(step["tol"])
+    worst = max(ref, key=lambda s: abs(ref[s] - got[s]))
+    drift = abs(ref[worst] - got[worst])
+    if drift > tol:
+        _fail({"loss_steps": len(ref), "max_loss_drift": drift},
+              f"loss stream diverged at step {worst}: "
+              f"|{ref[worst]} - {got[worst]}| = {drift:g} > {tol:g}")
+    return {"loss_steps": len(ref), "max_loss_drift": drift}
+
+
+def _check_ledger_nonzero(step, conductor):
+    ledger = _load_json(step["path"]).get("entries", {})
+    bad = [k for k, e in ledger.items()
+           if not all(e.get(f, 0) > 0 for f in step["fields"])]
+    observed = {"entries": sorted(ledger), "missing_bytes": sorted(bad)}
+    if not ledger or bad:
+        _fail(observed,
+              "ledger empty or missing nonzero "
+              + "/".join(step["fields"]))
+    return observed
+
+
+def _check_ledger_keys_match(step, conductor):
+    memory_keys = sorted(_load_json(step["memory"]).get("entries", {}))
+    flops_keys = sorted(_load_json(step["flops"]).get("entries", {}))
+    if memory_keys != flops_keys:
+        _fail({"memory_keys": memory_keys, "flops_keys": flops_keys},
+              "memory.json and flops.json certify different program "
+              "keys")
+    return {"ledger_keys": flops_keys}
+
+
+def _opt_entry(directory: str):
+    """First (sorted) ledger entry carrying the optimizer-slot
+    breakdown, or (None, None)."""
+    ledger = _load_json(os.path.join(directory, "memory.json")) \
+        .get("entries", {})
+    for key in sorted(ledger):
+        if "opt_state_argument_bytes" in ledger[key]:
+            return key, ledger[key]
+    return None, None
+
+
+def _check_ledger_opt_ratio(step, conductor):
+    r_key, r = _opt_entry(step["replicated_dir"])
+    z_key, z = _opt_entry(step["zero1_dir"])
+    if r is None or z is None:
+        _fail({"replicated_key": r_key, "zero1_key": z_key},
+              "ledger entry with the optimizer-slot breakdown missing")
+    r_opt = r.get("opt_state_argument_bytes", 0)
+    z_opt = z.get("opt_state_argument_bytes", 0)
+    ratio = (z_opt / r_opt) if r_opt else float("inf")
+    observed = {"replicated_key": r_key, "zero1_key": z_key,
+                "opt_bytes_replicated": r_opt,
+                "opt_bytes_zero1": z_opt,
+                "opt_ratio": round(ratio, 4),
+                "zero1_alias_bytes": z.get("alias_bytes", 0)}
+    if not (0 < z_opt and ratio < float(step["lt"])
+            and z.get("alias_bytes", 0) > 0):
+        _fail(observed,
+              f"zero1 optimizer-slot argument bytes not < "
+              f"{step['lt']}x the replicated twin's with donation "
+              f"intact")
+    return observed
+
+
+def _check_trace_export(step, conductor):
+    from tpu_resnet.obs.trace import export_trace
+
+    directory = step["dir"]
+    try:
+        _, trace = export_trace(directory)
+    except (OSError, ValueError) as e:
+        _fail(error=f"{type(e).__name__}: {e}")
+    run_id = None
+    try:
+        with open(os.path.join(directory, "manifest.json")) as f:
+            run_id = json.load(f).get("run_id")
+    except (OSError, ValueError):
+        pass
+    span_names = {e.get("name") for e in trace.get("traceEvents", [])}
+    observed = {"run_id": run_id,
+                "trace_events": len(trace.get("traceEvents", [])),
+                "span_names": sorted(n for n in span_names if n)}
+    ok = (run_id is not None
+          and trace.get("metadata", {}).get("run_id") == run_id
+          and set(step["require_spans"]) <= span_names)
+    if not ok:
+        _fail(observed,
+              "trace export run_id mismatch or required spans missing")
+    return observed
+
+
+def _check_oom_report(step, conductor):
+    from tpu_resnet.obs.memory import validate_oom_report
+
+    report = _load_json(step["path"])
+    problems = validate_oom_report(report)
+    census = report.get("live_arrays") or {}
+    if not census:
+        problems = list(problems) + ["live-array census is empty"]
+    observed = {"problems": problems,
+                "oom_census_buckets": len(census.get("buckets", [])),
+                "oom_census_bytes": census.get("total_bytes")}
+    if problems:
+        _fail(observed, "oom_report.json failed forensic validation")
+    return observed
+
+
+def _check_sweep_trajectory(step, conductor):
+    points = _load_json(step["path"]).get("points", [])
+    ids = {p.get("id") for p in points}
+    complete = ids == set(step["expect_ids"])
+    statuses = {p.get("id"): p.get("status") for p in points}
+    all_ok = bool(points) and all(s == "ok" for s in statuses.values())
+    deadline_honored = all(
+        p.get("deadline_margin_sec", 0) > 0
+        for p in points if p.get("status") == "ok")
+    observed = {"complete": complete, "statuses": statuses,
+                "deadline_honored": deadline_honored}
+    if not (complete and all_ok and deadline_honored):
+        _fail(observed, "sweep trajectory incomplete, failed, or over "
+                        "deadline")
+    return observed
+
+
+def _check_loadgen_result(step, conductor):
+    data = _load_json(step["path"])
+    observed = {k: data.get(k, 0)
+                for k in ("requests_ok", "failed", "timeouts",
+                          "connect_failures")}
+    bounds = (("failed", "max_failed", False),
+              ("timeouts", "max_timeouts", False),
+              ("connect_failures", "max_connect_failures", False),
+              ("requests_ok", "min_ok", True))
+    for field, knob, is_min in bounds:
+        if knob not in step:
+            continue
+        got, want = observed[field], step[knob]
+        if (got < want) if is_min else (got > want):
+            _fail(observed,
+                  f"loadgen {field}={got} violates {knob}={want}")
+    return observed
+
+
+def _check_burst_state(step, conductor):
+    path = os.path.join(step["dir"], "fault_burst_state.json")
+    state = _load_json(path)
+    observed = {"burst": state}
+    if state.get("fired", 0) != step["fired"]:
+        _fail(observed,
+              f"preempt burst fired {state.get('fired')} times, "
+              f"expected {step['fired']}")
+    return observed
+
+
+def _check_file_exists(step, conductor):
+    if not os.path.exists(step["path"]):
+        _fail({"path": step["path"]},
+              f"{step['path']} was never written")
+    return {"path": step["path"]}
+
+
+_CHECKERS = {
+    "ckpt_step": _check_ckpt_step,
+    "run_spans": _check_run_spans,
+    "span": _check_span,
+    "artifact_json": _check_artifact_json,
+    "loss_parity": _check_loss_parity,
+    "ledger_nonzero": _check_ledger_nonzero,
+    "ledger_keys_match": _check_ledger_keys_match,
+    "ledger_opt_ratio": _check_ledger_opt_ratio,
+    "trace_export": _check_trace_export,
+    "oom_report": _check_oom_report,
+    "sweep_trajectory": _check_sweep_trajectory,
+    "loadgen_result": _check_loadgen_result,
+    "burst_state": _check_burst_state,
+    "file_exists": _check_file_exists,
+}
+
+
+def evaluate(step: dict, conductor) -> dict:
+    return _CHECKERS[step["check"]](step, conductor)

@@ -1,0 +1,74 @@
+"""The scenario catalog: checked-in drill files under ``scenarios/``.
+
+``scenario list`` and ``doctor --list-probes`` both read this module so
+the two surfaces can never drift: every scenario FILE plus every legacy
+probe that still runs as bespoke code shows up in one listing with a
+one-line description.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from tpu_resnet.scenario import spec as _spec
+
+# Doctor probes that still run as bespoke code, not scenario files: the
+# fleet drills juggle per-replica hot-reload traffic loops and fleetmon
+# burn-alert timing that the declarative step grammar does not yet
+# express. Listed so `scenario list` shows the WHOLE drill surface.
+LEGACY_PROBES = {
+    "check": "end-to-end smoke: train + eval one batch on scrubbed CPU",
+    "data_bench": "input-pipeline throughput bench (no accelerator)",
+    "coldstart_probe": "AOT registry kills the warm-start recompile",
+    "fleet_probe": "router + 2 replicas: hot reload, drain, merged trace",
+    "fleetmon_probe": "fleet SLO aggregator: burn alerts + request lanes",
+    "perfwatch": "regression-gate the perf ledger against baselines",
+}
+
+
+def scenarios_dir() -> str:
+    return os.path.join(_spec.repo_root(), "scenarios")
+
+
+def scenario_path(name: str) -> str:
+    """Resolve a scenario reference: an existing file path wins, then
+    ``scenarios/<name>.json`` (and ``.toml``)."""
+    if os.path.exists(name):
+        return name
+    for ext in (".json", ".toml"):
+        candidate = os.path.join(scenarios_dir(), name + ext)
+        if os.path.exists(candidate):
+            return candidate
+    return os.path.join(scenarios_dir(), name + ".json")
+
+
+def list_scenarios() -> list:
+    """Sorted ``{"name", "path", "description", "tier"}`` for every
+    scenario file; unparseable files still list (description flags the
+    breakage) so a bad checked-in file can't hide from the catalog."""
+    out = []
+    directory = scenarios_dir()
+    if not os.path.isdir(directory):
+        return out
+    for fname in sorted(os.listdir(directory)):
+        if not fname.endswith((".json", ".toml")):
+            continue
+        path = os.path.join(directory, fname)
+        name = fname.rsplit(".", 1)[0]
+        description, tier = "(unparseable scenario file)", "?"
+        try:
+            with open(path, "rb") as f:
+                data = json.loads(f.read().decode()) \
+                    if fname.endswith(".json") else None
+            if data is None:  # .toml on an interpreter without tomllib
+                description, tier = "(toml scenario)", "?"
+            else:
+                name = data.get("name", name)
+                description = data.get("description", description)
+                tier = data.get("tier", "slow")
+        except (OSError, ValueError):
+            pass
+        out.append({"name": name, "path": path,
+                    "description": description, "tier": tier})
+    return out

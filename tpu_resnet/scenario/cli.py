@@ -1,0 +1,100 @@
+"""``python -m tpu_resnet scenario {run,list,validate}``.
+
+Exit codes follow resilience/exitcodes: 0 on success, 1 when a drill
+ran and failed its contract, USAGE_ERROR (2) for bad invocations AND
+invalid scenario files — a malformed drill file is an authoring error,
+not a drill failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_resnet.resilience.exitcodes import USAGE_ERROR
+from tpu_resnet.scenario import catalog, conductor, spec
+
+
+def _cmd_list(args) -> int:
+    rows = [(s["name"], s["tier"], s["description"], s["path"])
+            for s in catalog.list_scenarios()]
+    rows += [(name, "legacy", desc,
+              f"tools/doctor.py --{name.replace('_', '-')}")
+             for name, desc in sorted(catalog.LEGACY_PROBES.items())]
+    if not rows:
+        print("no scenarios found (scenarios/ missing?)")
+        return 1
+    width = max(len(r[0]) for r in rows)
+    for name, tier, desc, path in rows:
+        print(f"{name:{width}s}  [{tier:6s}]  {desc}")
+        if args.paths:
+            print(f"{'':{width}s}            {path}")
+    return 0
+
+
+def _cmd_validate(args) -> int:
+    rc = 0
+    for ref in args.scenario:
+        path = catalog.scenario_path(ref)
+        _, errors = spec.load_scenario(path)
+        if errors:
+            rc = USAGE_ERROR
+            print(f"{path}: INVALID")
+            for e in errors:
+                print(f"  [{e['error']}] {e['where']}: {e['detail']}")
+        else:
+            print(f"{path}: ok")
+    return rc
+
+
+def _cmd_run(args) -> int:
+    path = catalog.scenario_path(args.scenario)
+    result = conductor.conduct_file(
+        path, run_dir=args.run_dir,
+        stream=None if args.quiet else sys.stdout)
+    if args.quiet:
+        print("RESULT_JSON: " + json.dumps(result), flush=True)
+    if result.get("phase") == "validate":
+        for e in result.get("validation_errors", []):
+            print(f"  [{e['error']}] {e['where']}: {e['detail']}",
+                  file=sys.stderr)
+        return USAGE_ERROR
+    return 0 if result.get("ok") else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpu_resnet scenario",
+        description="run / list / validate declarative chaos scenarios")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="conduct one scenario file")
+    p_run.add_argument("scenario",
+                       help="scenario name (scenarios/<name>.json) or "
+                            "a file path")
+    p_run.add_argument("--run-dir", default=None,
+                       help="keep artifacts here instead of a "
+                            "temporary directory")
+    p_run.add_argument("--quiet", action="store_true",
+                       help="suppress per-step progress; still prints "
+                            "the final RESULT_JSON line")
+
+    p_list = sub.add_parser("list",
+                            help="every scenario file + legacy probe")
+    p_list.add_argument("--paths", action="store_true",
+                        help="also print file paths")
+
+    p_val = sub.add_parser("validate",
+                           help="schema-check scenario files (rc 2 on "
+                                "any error)")
+    p_val.add_argument("scenario", nargs="+",
+                       help="scenario names or file paths")
+
+    args = parser.parse_args(argv)
+    return {"run": _cmd_run, "list": _cmd_list,
+            "validate": _cmd_validate}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,796 @@
+"""The scenario conductor: one engine runs every drill file.
+
+Owns, exactly once, the skeleton every bespoke doctor probe used to
+hand-roll:
+
+- children under ``hostenv.scrubbed_cpu_env(devices)`` with the fault
+  schedule merged in AFTER the scrub (the scrub strips ``TPU_*`` — a
+  fault env var merged before it would silently vanish, the bug class
+  this module exists to retire);
+- child stdout/stderr to a FILE, never a pipe (nobody reads while we
+  wait; a chatty child against a full 64K pipe deadlocks ``wait()``);
+- discovery-file waits with deadlines (serve.json / serve-<name>.json /
+  route.json / telemetry.json — free ports come from ``port=0`` plus
+  these files, the repo's ephemeral-port idiom);
+- a reaper thread collecting child exits (one lock around the exit
+  table, an Event to wake waiters, polling outside the lock, stop-event
+  + join teardown — the tpu_resnet/analysis/concurrency.py contract);
+- survivor kill on first failure (SIGTERM, grace, SIGKILL);
+- a single RESULT_JSON writer and the perfwatch hand-off
+  (``sweep-scn:<scenario>:<metric>`` series).
+
+Jax-free at module scope (jaxlint host-isolation scope): the conductor
+runs on hosts whose accelerator stack is the thing being drilled; its
+children are the only processes that touch jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from tpu_resnet.hostenv import scrubbed_cpu_env
+from tpu_resnet.resilience.exitcodes import (
+    HOSTENV_SPAWN_FAILED,
+    HOSTENV_TIMEOUT,
+)
+from tpu_resnet.scenario import assertions as _assertions
+from tpu_resnet.scenario import spec as _spec
+
+DEFAULT_STEP_TIMEOUT = 300.0
+TAIL_LINES = 5
+RESULT_FILE = "scenario_result.json"
+
+_FAULT_ENV_PREFIX = "TPU_RESNET_FAULT_"  # faultinject.ENV_PREFIX
+
+
+class StepFailure(Exception):
+    """A step missed its contract: carries the structured observation
+    the RESULT_JSON (and the doctor adapters) report."""
+
+    def __init__(self, error=None, observed=None, tail=None):
+        self.error = error
+        self.observed = observed or {}
+        self.tail = tail
+        super().__init__(error or "step failed")
+
+
+def _tail_of(path):
+    try:
+        with open(path) as f:
+            return f.read().strip().splitlines()[-TAIL_LINES:]
+    except OSError:
+        return []
+
+
+def _format_override(key, value) -> str:
+    if isinstance(value, bool):
+        value = "true" if value else "false"
+    return f"{key}={value}"
+
+
+def _build_argv(proc: dict, root: str) -> list:
+    """Process spec → argv. Every kind funnels through the same three
+    appendables: preset, overrides (file order), extra args."""
+    kind = proc["kind"]
+    if kind == "cmd":
+        return list(proc["argv"])
+    if kind == "loadgen":
+        argv = [sys.executable, os.path.join(root, "tools", "loadgen.py")]
+    elif kind == "supervise":
+        argv = [sys.executable, os.path.join(root, "tools",
+                                             "supervise.py")]
+    elif kind == "sweep":
+        argv = [sys.executable, "-m", "tpu_resnet.tools.sweep"]
+    else:
+        argv = [sys.executable, "-m", "tpu_resnet", kind]
+        if proc.get("preset"):
+            argv += ["--preset", proc["preset"]]
+    argv += [_format_override(k, v)
+             for k, v in (proc.get("overrides") or {}).items()]
+    argv += [str(a) for a in (proc.get("args") or [])]
+    return argv
+
+
+def _child_env(proc: dict) -> dict:
+    """Scrub FIRST, then merge the process env and the fault schedule —
+    the ordering contract (scrubbed_cpu_env strips TPU_*, and the fault
+    vars are TPU_RESNET_FAULT_*)."""
+    env = scrubbed_cpu_env(int(proc.get("devices", 1)))
+    env.update({k: str(v) for k, v in (proc.get("env") or {}).items()})
+    for key, value in (proc.get("faults") or {}).items():
+        env[_FAULT_ENV_PREFIX + key] = str(value)
+    return env
+
+
+class _Child:
+    def __init__(self, name: str, proc_spec: dict, run_dir: str,
+                 root: str):
+        self.name = name
+        self.spec = proc_spec
+        self.log_path = os.path.join(run_dir, f"{name}.log")
+        self.log_fh = open(self.log_path, "w")
+        argv = _build_argv(proc_spec, root)
+        try:
+            self.proc = subprocess.Popen(
+                argv, env=_child_env(proc_spec), stdout=self.log_fh,
+                stderr=subprocess.STDOUT, text=True,
+                cwd=proc_spec.get("cwd") or None)
+        except OSError as e:
+            self.log_fh.write(f"spawn failed: {e}\n")
+            self.log_fh.flush()
+            self.proc = None
+
+    def tail(self):
+        self.log_fh.flush()
+        return _tail_of(self.log_path)
+
+    def close(self):
+        try:
+            self.log_fh.close()
+        except OSError:
+            pass
+
+
+class Conductor:
+    """Runs one validated, template-expanded scenario dict.
+
+    Threading contract (tpu_resnet/analysis/concurrency.py): ONE lock
+    guards the children/exit tables; the reaper polls children OUTSIDE
+    the lock and records exits under it; ``_exit_event`` wakes the main
+    thread's waits; teardown is stop-event then ``join`` with a
+    timeout. No I/O, no blocking call ever happens under ``_lock``.
+    """
+
+    def __init__(self, data: dict, run_dir: str, stream=None):
+        self.data = data
+        self.run_dir = run_dir
+        self.root = _spec.repo_root()
+        self.stream = stream
+        self.default_timeout = float(data.get("timeout",
+                                              DEFAULT_STEP_TIMEOUT))
+        self._lock = threading.Lock()
+        self._children: dict = {}   # name -> _Child (guarded by _lock)
+        self._exits: dict = {}      # name -> rc     (guarded by _lock)
+        self._exit_event = threading.Event()
+        self._stop = threading.Event()
+        self._reaper = threading.Thread(target=self._reap,
+                                        name="scenario-reaper",
+                                        daemon=True)
+        self.rcs: dict = {}         # main-thread view for RESULT_JSON
+        self.steps_out: list = []
+        self.observed: dict = {}    # label -> observed dict
+
+    # ----------------------------------------------------- child reaper
+    def _reap(self):
+        while not self._stop.is_set():
+            with self._lock:
+                live = [(n, c) for n, c in self._children.items()
+                        if n not in self._exits and c.proc is not None]
+            exited = []
+            for name, child in live:  # poll OUTSIDE the lock
+                rc = child.proc.poll()
+                if rc is not None:
+                    exited.append((name, rc))
+            if exited:
+                with self._lock:
+                    self._exits.update(exited)
+                self._exit_event.set()
+            self._stop.wait(0.2)
+
+    def _exit_code(self, name):
+        with self._lock:
+            return self._exits.get(name)
+
+    def _spawn(self, name: str) -> _Child:
+        child = _Child(name, self.data["processes"][name], self.run_dir,
+                       self.root)
+        with self._lock:
+            self._children[name] = child
+            if child.proc is None:
+                self._exits[name] = HOSTENV_SPAWN_FAILED
+        if child.proc is None:
+            self._exit_event.set()
+        return child
+
+    def _child(self, name: str) -> _Child:
+        with self._lock:
+            return self._children[name]
+
+    def _wait_exit(self, name: str, timeout: float):
+        """rc once the reaper records the exit, None on deadline."""
+        deadline = time.monotonic() + timeout
+        while True:
+            rc = self._exit_code(name)
+            if rc is not None:
+                return rc
+            if time.monotonic() >= deadline:
+                return None
+            if self._exit_event.wait(0.2):
+                self._exit_event.clear()
+
+    def _kill_survivors(self):
+        with self._lock:
+            live = [(n, c) for n, c in self._children.items()
+                    if n not in self._exits and c.proc is not None]
+        for _, child in live:
+            try:
+                child.proc.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 10
+        for name, child in live:
+            while (self._exit_code(name) is None
+                   and time.monotonic() < deadline):
+                if self._exit_event.wait(0.2):
+                    self._exit_event.clear()
+            if self._exit_code(name) is None:
+                try:
+                    child.proc.kill()
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------- utilities
+    def _log(self, line: str):
+        if self.stream is not None:
+            print(f"[scenario] {line}", file=self.stream, flush=True)
+
+    def _port_of(self, step: dict):
+        """Discovery-file port for a step's source/target endpoint."""
+        from tpu_resnet.obs.server import read_telemetry_port
+        from tpu_resnet.serve.discovery import read_port
+        from tpu_resnet.serve.router import read_route_port
+
+        source = step.get("source") or step.get("target") or "serve"
+        directory = step["dir"]
+        if source == "route":
+            return read_route_port(directory)
+        if source == "telemetry":
+            return read_telemetry_port(directory)
+        name = step.get("name")
+        return read_port(directory,
+                         f"serve-{name}.json" if name else "serve.json")
+
+    def _http_json(self, port: int, path: str, timeout: float = 2.0):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def _ckpt_steps(self, directory: str) -> list:
+        return (sorted(int(n) for n in os.listdir(directory)
+                       if n.isdigit())
+                if os.path.isdir(directory) else [])
+
+    def _run_spans(self, directory: str) -> list:
+        from tpu_resnet.obs.spans import load_spans
+
+        return [[s.get("start_step"), s.get("stop_step")]
+                for s in load_spans(os.path.join(directory,
+                                                 "events.jsonl"))
+                if s["span"] == "run"]
+
+    def _check_exit(self, step: dict, rc: int, tail):
+        """The combined exit contract every drill shares: expected rc,
+        optionally a checkpoint at the stop step, optionally the exact
+        run-span history. Failures carry every observation at once (the
+        historical probe shape: rc + expected_rc + ckpt_steps in ONE
+        dict)."""
+        observed = {"rc": rc}
+        ok = True
+        allowed = _spec.resolve_rc(step.get("expect_rc", 0))
+        if allowed is not None:
+            rc_ok = (rc in [a for a in allowed if a != "nonzero"]
+                     or ("nonzero" in allowed and rc != 0))
+            if not rc_ok:
+                ok = False
+                observed["expected_rc"] = (
+                    allowed[0] if len(allowed) == 1 else allowed)
+        if "expect_ckpt" in step:
+            steps = self._ckpt_steps(step["expect_ckpt"]["dir"])
+            observed["ckpt_steps"] = steps
+            if step["expect_ckpt"]["step"] not in steps:
+                ok = False
+                allowed = allowed or []
+                observed.setdefault(
+                    "expected_rc",
+                    allowed[0] if len(allowed) == 1 else allowed)
+        if "expect_run_spans" in step:
+            spans = self._run_spans(step["expect_run_spans"]["dir"])
+            observed["run_spans"] = spans
+            expect = [list(s) for s in
+                      step["expect_run_spans"]["spans"]]
+            if spans != expect:
+                ok = False
+        if not ok:
+            raise StepFailure(observed=observed, tail=tail)
+        return observed
+
+    # ------------------------------------------------------- step kinds
+    def _step_run(self, step):
+        name = step["proc"]
+        timeout = float(step.get("timeout", self.default_timeout))
+        child = self._spawn(name)
+        rc = self._wait_exit(name, timeout)
+        if rc is None:
+            try:
+                child.proc.kill()
+            except OSError:
+                pass
+            self._wait_exit(name, 10)
+            rc = HOSTENV_TIMEOUT
+            with self._lock:
+                self._exits.setdefault(name, rc)
+        self.rcs[name] = rc
+        return self._check_exit(step, rc, child.tail())
+
+    def _step_start(self, step):
+        child = self._spawn(step["proc"])
+        if child.proc is None:
+            raise StepFailure(error="spawn failed",
+                              observed={"rc": HOSTENV_SPAWN_FAILED},
+                              tail=child.tail())
+        return {"pid": child.proc.pid}
+
+    def _step_signal(self, step):
+        child = self._child(step["proc"])
+        sig = getattr(signal, "SIG" + step["sig"].upper())
+        try:
+            child.proc.send_signal(sig)
+        except OSError:
+            pass
+        return {"sig": step["sig"].upper()}
+
+    def _step_wait_exit(self, step):
+        name = step["proc"]
+        timeout = float(step.get("timeout", self.default_timeout))
+        child = self._child(name)
+        rc = self._wait_exit(name, timeout)
+        if rc is None:
+            try:
+                child.proc.kill()
+            except OSError:
+                pass
+            self._wait_exit(name, 10)
+            raise StepFailure(
+                error=step.get("timeout_error",
+                               f"{name} did not exit within "
+                               f"{int(timeout)}s"),
+                tail=child.tail())
+        self.rcs[name] = rc
+        return self._check_exit(step, rc, child.tail())
+
+    def _step_stop(self, step):
+        self._step_signal(dict(step, sig=step.get("sig", "TERM")))
+        return self._step_wait_exit(step)
+
+    def _step_wait_ready(self, step):
+        """Discovery file names a port AND /healthz says ok, under a
+        deadline, while the child is still alive."""
+        name = step["proc"]
+        child = self._child(name)
+        timeout = float(step.get("timeout", self.default_timeout))
+        deadline = time.monotonic() + timeout
+        min_replicas = step.get("min_replicas", 0)
+        while time.monotonic() < deadline:
+            if self._exit_code(name) is not None:
+                raise StepFailure(observed={"rc": self._exit_code(name)},
+                                  tail=child.tail())
+            port = self._port_of(step)
+            if port is not None:
+                try:
+                    health = self._http_json(port, "/healthz")
+                    if health.get("ok") and int(health.get(
+                            "replicas_healthy", min_replicas)) \
+                            >= min_replicas:
+                        return {"port": port}
+                except (OSError, ValueError):
+                    pass  # 503 (warming) / not listening yet
+            time.sleep(0.3)
+        raise StepFailure(error=step.get(
+            "timeout_error", f"{name} never became ready"),
+            observed={"rc": self._exit_code(name)}, tail=child.tail())
+
+    def _step_predict(self, step):
+        port = self._port_of(step)
+        shape = [int(x) for x in step["shape"]]
+        n_bytes = 1
+        for x in shape:
+            n_bytes *= x
+        body = bytes(n_bytes)
+        expect = step.get("expect_predictions", shape[0])
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-Shape": ",".join(str(x) for x in shape)}
+        if step.get("lane"):
+            headers["X-Lane"] = step["lane"]
+        ok_requests = 0
+        for _ in range(step.get("n", 1)):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    payload = json.loads(r.read())
+                if len(payload.get("predictions", [])) == expect:
+                    ok_requests += 1
+            except (OSError, ValueError):
+                pass
+        observed = {"ok_requests": ok_requests, "port": port}
+        if step.get("required") and ok_requests < step.get("n", 1):
+            raise StepFailure(observed=observed)
+        return observed
+
+    def _step_scrape(self, step):
+        """One /metrics scrape; NEVER fails the scenario (the historical
+        probes degrade the value to -1 and let the composite verdict
+        fail instead — a dead endpoint is a FAILED check downstream, not
+        a conductor crash)."""
+        from tpu_resnet.obs.server import parse_prometheus
+
+        try:
+            port = self._port_of(step)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                metrics = parse_prometheus(r.read().decode())
+            return {m: metrics.get(m, 0) for m in step["metrics"]}
+        except (OSError, ValueError, TypeError):
+            return {m: -1 for m in step["metrics"]}
+
+    def _step_scrape_until(self, step):
+        """Poll /metrics while the child lives until every condition
+        holds at once, then collect from that same scrape."""
+        from tpu_resnet.obs.server import parse_histograms, parse_prometheus
+
+        name = step["proc"]
+        child = self._child(name)
+        timeout = float(step.get("timeout", self.default_timeout))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline \
+                and self._exit_code(name) is None:
+            port = self._port_of(step)
+            if port is not None:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics",
+                            timeout=2) as r:
+                        text = r.read().decode()
+                    metrics = parse_prometheus(text)
+                    hists = parse_histograms(text)
+                    if self._conditions_hold(step["conditions"],
+                                             metrics, hists):
+                        out = {}
+                        for c in step.get("collect", []):
+                            if "metric" in c:
+                                out[c["key"]] = metrics.get(c["metric"])
+                            else:
+                                out[c["key"]] = hists.get(
+                                    c["hist_count"], {}).get("count", 0)
+                        return out
+                except (OSError, ValueError):
+                    pass  # not listening yet / mid-write
+            time.sleep(0.3)
+        raise StepFailure(
+            error=step.get("timeout_error",
+                           "metrics conditions never went live"),
+            tail=child.tail())
+
+    @staticmethod
+    def _conditions_hold(conditions, metrics, hists) -> bool:
+        for c in conditions:
+            if "file" in c:
+                if not os.path.exists(c["file"]):
+                    return False
+            elif "hist_count" in c:
+                count = hists.get(c["hist_count"], {}).get("count", 0)
+                if count <= c.get("gt", -1):
+                    return False
+            else:
+                if c["metric"] not in metrics:
+                    return False
+                if "gt" in c and metrics[c["metric"]] <= c["gt"]:
+                    return False
+        return True
+
+    def _step_http_json(self, step):
+        """GET a JSON endpoint; with ``until`` poll (under the deadline)
+        for dotted fields to equal the expected values; ``collect``
+        records dotted fields into the observation."""
+        timeout = float(step.get("timeout", self.default_timeout))
+        deadline = time.monotonic() + timeout
+        until = step.get("until") or {}
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                port = self._port_of(step)
+                if port is not None:
+                    last = self._http_json(port, step["path"])
+                    if all(_assertions.dotted_get(last, k) == v
+                           for k, v in until.items()):
+                        return {k: _assertions.dotted_get(last, d)
+                                for k, d in
+                                (step.get("collect") or {}).items()} \
+                            or {"ok": True}
+            except (OSError, ValueError, TypeError):
+                pass
+            if not until:
+                break
+            time.sleep(0.3)
+        raise StepFailure(error=f"{step['path']} never matched {until}",
+                          observed={"last": last})
+
+    def _step_corrupt_ckpt(self, step):
+        from tpu_resnet.resilience.faultinject import corrupt_checkpoint
+
+        corrupted = corrupt_checkpoint(step["dir"],
+                                       step.get("step"))
+        if corrupted is None:
+            raise StepFailure(error=f"no checkpoint to corrupt under "
+                                    f"{step['dir']}")
+        return {"corrupted_step": corrupted}
+
+    def _step_drain(self, step):
+        from tpu_resnet.serve.router import read_route_port, request_drain
+
+        port = read_route_port(step["dir"])
+        if port is None:
+            raise StepFailure(error="no route.json to drain through")
+        verdict = request_drain(f"http://127.0.0.1:{port}",
+                                step["replica"])
+        if not verdict.get("ok"):
+            raise StepFailure(error="admin drain refused",
+                              observed={"drain": verdict})
+        return {"drain": verdict}
+
+    def _step_sleep(self, step):
+        time.sleep(float(step["seconds"]))
+        return {}
+
+    def _step_assert(self, step):
+        return _assertions.evaluate(step, self)
+
+    # ---------------------------------------------------------- driver
+    _EXECUTORS = {
+        "run": _step_run, "start": _step_start, "signal": _step_signal,
+        "wait_exit": _step_wait_exit, "stop": _step_stop,
+        "wait_ready": _step_wait_ready, "predict": _step_predict,
+        "scrape": _step_scrape, "scrape_until": _step_scrape_until,
+        "http_json": _step_http_json, "corrupt_ckpt": _step_corrupt_ckpt,
+        "drain": _step_drain, "sleep": _step_sleep,
+        "assert": _step_assert,
+    }
+
+    def conduct(self) -> dict:
+        started = time.monotonic()
+        self._reaper.start()
+        result = {"scenario": self.data["name"], "ok": True,
+                  "phase": None, "error": None, "rcs": self.rcs,
+                  "steps": self.steps_out, "assertions": [],
+                  "series": [], "perfwatch": {"ran": False},
+                  "series_skipped": [], "elapsed_sec": None}
+        steps = list(self.data["steps"])
+        steps += [dict(a, do="assert")
+                  for a in self.data.get("assertions") or []]
+        try:
+            for i, step in enumerate(steps):
+                kind = step["do"]
+                label = step.get("label", f"s{i}:{kind}")
+                phase = step.get("phase", kind)
+                entry = {"label": label, "do": kind, "phase": phase}
+                if kind == "assert":
+                    entry["check"] = step["check"]
+                self._log(f"{label} ({phase})")
+                try:
+                    observed = self._EXECUTORS[kind](self, step)
+                except StepFailure as f:
+                    entry.update(ok=False, observed=f.observed)
+                    if f.error:
+                        entry["error"] = f.error
+                    if f.tail is not None:
+                        entry["tail"] = f.tail
+                    self.steps_out.append(entry)
+                    self.observed[label] = f.observed
+                    result.update(ok=False, phase=phase,
+                                  error=f.error)
+                    break
+                entry.update(ok=True, observed=observed)
+                if kind in ("run", "wait_exit", "stop", "start"):
+                    child = self._child(step["proc"])
+                    entry["tail"] = child.tail()
+                self.steps_out.append(entry)
+                self.observed[label] = observed
+            else:
+                self._emit_series(result)
+        finally:
+            self._kill_survivors()
+            self._stop.set()
+            self._reaper.join(timeout=5)
+            with self._lock:
+                children = list(self._children.values())
+            for child in children:
+                child.close()
+        result["elapsed_sec"] = round(time.monotonic() - started, 1)
+        self._write_result(result)
+        return result
+
+    # ----------------------------------------------- series → perfwatch
+    _FIELD_PREFIX = {"steps_per_sec": "sweep:",
+                     "hbm_bytes_peak": "sweep-mem:",
+                     "time_to_ready_s": "sweep-ttr:",
+                     "latency_ms": "sweep-lat:",
+                     "scenario_value": "sweep-scn:"}
+
+    def _series_value(self, entry):
+        source = entry["source"]
+        if source == "metrics":
+            from tpu_resnet.obs.spans import load_jsonl
+
+            records = load_jsonl(os.path.join(entry["dir"],
+                                              "metrics.jsonl"), "step")
+            field = entry.get("field", "steps_per_sec")
+            values = [r[field] for r in records
+                      if r.get(field)
+                      and r["step"] >= entry.get("min_step", 0)
+                      and r["step"] <= entry.get("max_step", 1 << 60)]
+            if not values:
+                return None
+            nd = entry.get("round", 3)
+            mean = sum(values) / len(values)  # stat: mean
+            # Keep the pre-scale mean alongside: normalized points feed
+            # perfwatch cohorts, raw feeds byte-compatible probe JSON.
+            return (round(mean * entry.get("scale", 1), nd),
+                    round(mean, nd))
+        if source == "ledger":
+            path = os.path.join(entry["dir"], "memory.json")
+            try:
+                with open(path) as f:
+                    entries = json.load(f).get("entries", {})
+            except (OSError, ValueError):
+                return None
+            want_opt = entry.get("entry", "opt_state") == "opt_state"
+            for _, e in sorted(entries.items()):
+                if not want_opt or "opt_state_argument_bytes" in e:
+                    value = e.get(entry.get("field", "peak_bytes"), 0)
+                    return int(value) if value else None
+            return None
+        if source == "loadgen":
+            try:
+                with open(entry["path"]) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                return None
+            return _assertions.dotted_get(data, entry["field"])
+        if source == "observed":
+            return (self.observed.get(entry["step"]) or {}).get(
+                entry["key"])
+        return None
+
+    def _emit_series(self, result: dict) -> None:
+        """Build the sweep-shaped trajectory, hand it (plus any raw
+        pass-through files) to tools/perfwatch.py, and record which
+        expected metric tokens it printed. Scenario-native values ride
+        the ``sweep-scn:<scenario>:<metric>`` prefix; entries may opt
+        into a legacy field (steps_per_sec / hbm_bytes_peak / ...) to
+        extend the historical probe cohorts."""
+        entries = self.data.get("series") or []
+        if not entries:
+            return
+        points, expected, extra_files = [], [], []
+        for entry in entries:
+            if entry["source"] == "file":
+                extra_files.append(entry["path"])
+                try:
+                    with open(entry["path"]) as f:
+                        for p in json.load(f).get("points", []):
+                            if p.get("id"):
+                                expected.append(f"sweep:{p['id']}")
+                except (OSError, ValueError):
+                    result["perfwatch"] = {
+                        "ran": False,
+                        "reason": f"unreadable {entry['path']}"}
+                    result["ok"] = False
+                    result.setdefault("phase", "perfwatch")
+                continue
+            value = self._series_value(entry)
+            raw = None
+            if isinstance(value, tuple):
+                value, raw = value
+            if value is None:
+                result["series_skipped"].append(entry["id"])
+                continue
+            out_field = entry.get("out", "scenario_value")
+            point_id = (entry["id"] if out_field != "scenario_value"
+                        else f"{self.data['name']}:{entry['id']}")
+            point = {"id": point_id, "status": "ok", "backend": "cpu"}
+            if out_field != "steps_per_sec":
+                point["steps_per_sec"] = 1.0
+            point[out_field] = value
+            if raw is not None and raw != value:
+                point["raw_value"] = raw
+            points.append(point)
+            expected.append(self._FIELD_PREFIX[out_field] + point_id)
+        result["series"] = points
+        script = os.path.join(self.root, "tools", "perfwatch.py")
+        if not os.path.exists(script):
+            result["perfwatch"] = {
+                "ran": False, "reason": "no tools/perfwatch.py"}
+            return
+        if not points and not extra_files:
+            result["perfwatch"] = {
+                "ran": False, "reason": "no series samples"}
+            return
+        argv = [sys.executable, script]
+        if points:
+            traj_path = os.path.join(self.run_dir, "scenario_sweep.json")
+            with open(traj_path, "w") as f:
+                json.dump({"metric": f"scenario:{self.data['name']}",
+                           "backend": "cpu", "points": points}, f)
+            argv += ["--sweep", traj_path]
+        for path in extra_files:
+            argv += ["--sweep", path]
+        try:
+            pw = subprocess.run(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                timeout=60)
+        except subprocess.TimeoutExpired:
+            result["perfwatch"] = {"ran": True, "rc": None,
+                                   "hung": True, "ingested": {}}
+            result["ok"] = False
+            result["phase"] = result["phase"] or "perfwatch"
+            return
+        ingested = {t: (t in pw.stdout) for t in expected}
+        result["perfwatch"] = {
+            "ran": True, "rc": pw.returncode, "ingested": ingested,
+            "tail": pw.stdout.strip().splitlines()[-TAIL_LINES:]}
+        if pw.returncode != 0 or not all(ingested.values()):
+            result["ok"] = False
+            result["phase"] = result["phase"] or "perfwatch"
+            result["error"] = result["error"] or \
+                "perfwatch did not ingest every scenario series"
+
+    def _write_result(self, result: dict) -> None:
+        path = os.path.join(self.run_dir, RESULT_FILE)
+        try:
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(result, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+        if self.stream is not None:
+            print("RESULT_JSON: " + json.dumps(result),
+                  file=self.stream, flush=True)
+
+
+def conduct(data: dict, run_dir: str, stream=None) -> dict:
+    """Run a validated scenario dict in ``run_dir`` (templates must
+    already be expanded by the caller — see :func:`conduct_file`)."""
+    return Conductor(data, run_dir, stream=stream).conduct()
+
+
+def conduct_file(path: str, run_dir: str = None, stream=None) -> dict:
+    """Load, validate, template-expand and run one scenario file. With
+    no ``run_dir`` a temporary scratch directory is created and removed
+    afterwards. Validation errors return a failed result without
+    spawning anything (``"phase": "validate"``)."""
+    import tempfile
+
+    data, errors = _spec.load_scenario(path)
+    if errors:
+        return {"scenario": (data or {}).get("name") or
+                os.path.basename(path), "ok": False,
+                "phase": "validate", "error": "scenario file invalid",
+                "validation_errors": errors}
+    if run_dir is not None:
+        os.makedirs(run_dir, exist_ok=True)
+        expanded = _spec.expand_templates(data, run_dir,
+                                          _spec.repo_root())
+        return conduct(expanded, run_dir, stream=stream)
+    with tempfile.TemporaryDirectory(
+            prefix=f"tpu_resnet_scn_{data['name']}_") as d:
+        expanded = _spec.expand_templates(data, d, _spec.repo_root())
+        return conduct(expanded, d, stream=stream)

@@ -1,0 +1,397 @@
+"""Scenario schema: load + validate, with NAMED errors.
+
+A scenario file is data, not code — so a typo'd field must fail
+``scenario validate`` with an error an author can grep for, not surface
+as a KeyError inside the conductor mid-drill. Validation is hand-rolled
+(no external schema dependency) and exhaustive: unknown fields are
+rejected everywhere, process references are resolved, fault keys are
+checked against the ``faultinject`` env contract, and exit-code
+expectations resolve through ``resilience/exitcodes``.
+
+Errors are dicts ``{"error": <name>, "where": <path>, "detail": ...}``
+where ``<name>`` is one of ERROR_NAMES — the test surface and the
+``scenario validate`` output contract (rc 2 on any error).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+# Process kinds the conductor knows how to spawn (conductor._build_argv).
+PROC_KINDS = ("train", "train_and_eval", "eval", "serve", "route",
+              "fleetmon", "loadgen", "supervise", "sweep", "cmd")
+
+# The faultinject env contract: TPU_RESNET_FAULT_<key> (faultinject.py
+# FaultPlan.from_config). Validated here so a typo'd fault silently
+# injecting nothing is impossible.
+FAULT_KEYS = ("NAN_STEP", "STALL_STEP", "STALL_SEC", "SIGTERM_STEP",
+              "CORRUPT_CKPT", "OOM_STEP", "PREEMPT_BURST",
+              "PREEMPT_BURST_EVERY", "SERVE_SLOW_MS", "SERVE_HANG_REQ",
+              "SERVE_KILL_REQ", "SERVE_DROP_REQ")
+
+# Symbolic exit-code expectations → resilience/exitcodes names.
+RC_NAMES = ("done", "drained", "preempt", "no_capacity", "usage_error",
+            "nonzero", "any")
+
+STEP_KINDS = ("run", "start", "signal", "wait_exit", "stop",
+              "wait_ready", "predict", "scrape", "scrape_until",
+              "http_json", "corrupt_ckpt", "drain", "sleep", "assert")
+
+ASSERT_CHECKS = ("ckpt_step", "run_spans", "span", "artifact_json",
+                 "loss_parity", "ledger_nonzero", "ledger_keys_match",
+                 "ledger_opt_ratio", "trace_export", "oom_report",
+                 "sweep_trajectory", "loadgen_result", "burst_state",
+                 "file_exists")
+
+SERIES_SOURCES = ("metrics", "ledger", "loadgen", "observed", "file")
+
+ERROR_NAMES = ("unreadable", "not_an_object", "missing_field",
+               "unknown_field", "bad_type", "empty", "unknown_kind",
+               "unknown_step", "unknown_check", "unknown_source",
+               "unknown_proc", "unknown_fault", "bad_expect_rc",
+               "duplicate_label", "toml_unsupported")
+
+
+def _err(name: str, where: str, detail: str) -> dict:
+    assert name in ERROR_NAMES
+    return {"error": name, "where": where, "detail": detail}
+
+
+def load_scenario(path: str):
+    """(data, errors): parse ``path`` as JSON (or TOML when the
+    interpreter ships ``tomllib``) and validate. ``data`` is None when
+    the file can't be parsed at all."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        return None, [_err("unreadable", path, f"{type(e).__name__}: {e}")]
+    if path.endswith(".toml"):
+        try:
+            import tomllib
+        except ImportError:
+            return None, [_err("toml_unsupported", path,
+                               "this interpreter has no tomllib (needs "
+                               "python >= 3.11); use JSON")]
+        try:
+            data = tomllib.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            return None, [_err("unreadable", path,
+                               f"TOML parse failed: {e}")]
+    else:
+        try:
+            data = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            return None, [_err("unreadable", path,
+                               f"JSON parse failed: {e}")]
+    return data, validate_scenario(data)
+
+
+# --------------------------------------------------------------- checks
+def _check_fields(obj: dict, where: str, required: dict, optional: dict,
+                  errors: list) -> None:
+    """Required/optional field presence + type checks; unknown fields
+    are named errors (the drill author typo'd something)."""
+    for field, types in required.items():
+        if field not in obj:
+            errors.append(_err("missing_field", where,
+                               f"required field {field!r} missing"))
+        elif not isinstance(obj[field], types):
+            errors.append(_err("bad_type", f"{where}.{field}",
+                               f"expected {types}, got "
+                               f"{type(obj[field]).__name__}"))
+    for field, value in obj.items():
+        if field in required:
+            continue
+        if field not in optional:
+            errors.append(_err("unknown_field", f"{where}.{field}",
+                               f"unknown field {field!r}"))
+        elif not isinstance(value, optional[field]):
+            errors.append(_err("bad_type", f"{where}.{field}",
+                               f"expected {optional[field]}, got "
+                               f"{type(value).__name__}"))
+
+
+def _check_expect_rc(value, where: str, errors: list) -> None:
+    items = value if isinstance(value, list) else [value]
+    for item in items:
+        if isinstance(item, bool) or not isinstance(item, (int, str)):
+            errors.append(_err("bad_expect_rc", where,
+                               f"expected int or one of {RC_NAMES}, "
+                               f"got {item!r}"))
+        elif isinstance(item, str) and item not in RC_NAMES:
+            errors.append(_err("bad_expect_rc", where,
+                               f"{item!r} is not one of {RC_NAMES}"))
+
+
+_NUM = (int, float)
+_STR_NUM_BOOL = (str, int, float, bool)
+_EXPECT_RC = (int, str, list)
+_CKPT_SPEC = {"dir": (str,), "step": (int,)}
+
+# Per-step allowed fields beyond the common {label, phase, timeout}.
+_STEP_REQUIRED = {
+    "run": {"proc": (str,)},
+    "start": {"proc": (str,)},
+    "signal": {"proc": (str,), "sig": (str,)},
+    "wait_exit": {"proc": (str,)},
+    "stop": {"proc": (str,)},
+    "wait_ready": {"proc": (str,), "dir": (str,)},
+    "predict": {"dir": (str,), "shape": (list,)},
+    "scrape": {"source": (str,), "dir": (str,), "metrics": (list,)},
+    "scrape_until": {"proc": (str,), "source": (str,), "dir": (str,),
+                     "conditions": (list,)},
+    "http_json": {"source": (str,), "dir": (str,), "path": (str,)},
+    "corrupt_ckpt": {"dir": (str,)},
+    "drain": {"dir": (str,), "replica": (str,)},
+    "sleep": {"seconds": _NUM},
+    "assert": {"check": (str,)},
+}
+_STEP_OPTIONAL = {
+    "run": {"expect_rc": _EXPECT_RC, "expect_ckpt": (dict,),
+            "expect_run_spans": (dict,)},
+    "start": {},
+    "signal": {},
+    "wait_exit": {"expect_rc": _EXPECT_RC, "expect_ckpt": (dict,),
+                  "expect_run_spans": (dict,), "timeout_error": (str,)},
+    "stop": {"sig": (str,), "expect_rc": _EXPECT_RC,
+             "timeout_error": (str,)},
+    "wait_ready": {"name": (str,), "min_replicas": (int,),
+                   "source": (str,), "timeout_error": (str,)},
+    "predict": {"target": (str,), "name": (str,), "n": (int,),
+                "expect_predictions": (int,), "required": (bool,),
+                "lane": (str,)},
+    "scrape": {"name": (str,)},
+    "scrape_until": {"collect": (list,), "name": (str,),
+                     "timeout_error": (str,)},
+    "http_json": {"name": (str,), "until": (dict,), "collect": (dict,)},
+    "corrupt_ckpt": {"step": (int,)},
+    "drain": {},
+    "sleep": {},
+    "assert": {},  # remaining fields validated per-check below
+}
+
+_ASSERT_REQUIRED = {
+    "ckpt_step": {"dir": (str,), "step": (int,)},
+    "run_spans": {"dir": (str,), "spans": (list,)},
+    "span": {"dir": (str,), "name": (str,)},
+    "artifact_json": {"path": (str,)},
+    "loss_parity": {"dir": (str,), "ref_dir": (str,), "tol": _NUM},
+    "ledger_nonzero": {"path": (str,), "fields": (list,)},
+    "ledger_keys_match": {"memory": (str,), "flops": (str,)},
+    "ledger_opt_ratio": {"replicated_dir": (str,), "zero1_dir": (str,),
+                         "lt": _NUM},
+    "trace_export": {"dir": (str,), "require_spans": (list,)},
+    "oom_report": {"path": (str,)},
+    "sweep_trajectory": {"path": (str,), "expect_ids": (list,)},
+    "loadgen_result": {"path": (str,)},
+    "burst_state": {"dir": (str,), "fired": (int,)},
+    "file_exists": {"path": (str,)},
+}
+_ASSERT_OPTIONAL = {
+    "span": {"file": (str,), "attrs": (dict,)},
+    "artifact_json": {"expect": (dict,), "collect": (dict,)},
+    "loadgen_result": {"max_failed": (int,), "max_timeouts": (int,),
+                       "max_connect_failures": (int,), "min_ok": (int,)},
+}
+
+_SERIES_REQUIRED = {
+    "metrics": {"id": (str,), "dir": (str,)},
+    "ledger": {"id": (str,), "dir": (str,)},
+    "loadgen": {"id": (str,), "path": (str,), "field": (str,)},
+    "observed": {"id": (str,), "step": (str,), "key": (str,)},
+    "file": {"path": (str,)},
+}
+_SERIES_OPTIONAL = {
+    "metrics": {"field": (str,), "stat": (str,), "min_step": (int,),
+                "max_step": (int,), "scale": _NUM, "round": (int,),
+                "out": (str,)},
+    "ledger": {"entry": (str,), "field": (str,), "out": (str,)},
+    "loadgen": {"out": (str,)},
+    "observed": {"out": (str,)},
+    "file": {},
+}
+
+
+def _validate_step(i: int, step, proc_names, labels: set,
+                   errors: list) -> None:
+    where = f"steps[{i}]"
+    if not isinstance(step, dict):
+        errors.append(_err("bad_type", where, "step must be an object"))
+        return
+    kind = step.get("do")
+    if kind not in STEP_KINDS:
+        errors.append(_err("unknown_step", where,
+                           f"do={kind!r} is not one of {STEP_KINDS}"))
+        return
+    common_opt = {"do": (str,), "label": (str,), "phase": (str,),
+                  "timeout": _NUM}
+    if kind == "assert":
+        check = step.get("check")
+        if not isinstance(check, str) or check not in ASSERT_CHECKS:
+            errors.append(_err("unknown_check", where,
+                               f"check={check!r} is not one of "
+                               f"{ASSERT_CHECKS}"))
+            return
+        required = dict(_ASSERT_REQUIRED[check], check=(str,))
+        optional = dict(_ASSERT_OPTIONAL.get(check, {}), **common_opt)
+    else:
+        required = _STEP_REQUIRED[kind]
+        optional = dict(_STEP_OPTIONAL[kind], **common_opt)
+    _check_fields(step, where, required, optional, errors)
+    proc = step.get("proc")
+    if proc is not None and isinstance(proc, str) \
+            and proc not in proc_names:
+        errors.append(_err("unknown_proc", f"{where}.proc",
+                           f"step references undeclared process "
+                           f"{proc!r}"))
+    if "expect_rc" in step and isinstance(step["expect_rc"], _EXPECT_RC):
+        _check_expect_rc(step["expect_rc"], f"{where}.expect_rc", errors)
+    for field, shape in (("expect_ckpt", _CKPT_SPEC),):
+        sub = step.get(field)
+        if isinstance(sub, dict):
+            _check_fields(sub, f"{where}.{field}", shape, {}, errors)
+    label = step.get("label")
+    if isinstance(label, str):
+        if label in labels:
+            errors.append(_err("duplicate_label", f"{where}.label",
+                               f"label {label!r} already used"))
+        labels.add(label)
+
+
+def validate_scenario(data) -> list:
+    """Full schema validation → list of named-error dicts (empty when
+    the scenario is well-formed)."""
+    errors: list = []
+    if not isinstance(data, dict):
+        return [_err("not_an_object", "$",
+                     "scenario root must be an object")]
+    _check_fields(
+        data, "$",
+        required={"name": (str,), "description": (str,),
+                  "processes": (dict,), "steps": (list,)},
+        optional={"timeout": _NUM, "tier": (str,),
+                  "assertions": (list,), "series": (list,)},
+        errors=errors)
+
+    processes = data.get("processes")
+    proc_names = set(processes) if isinstance(processes, dict) else set()
+    if isinstance(processes, dict):
+        if not processes:
+            errors.append(_err("empty", "$.processes",
+                               "a scenario needs at least one process"))
+        for name, proc in processes.items():
+            where = f"$.processes.{name}"
+            if not isinstance(proc, dict):
+                errors.append(_err("bad_type", where,
+                                   "process must be an object"))
+                continue
+            kind = proc.get("kind")
+            if kind not in PROC_KINDS:
+                errors.append(_err("unknown_kind", f"{where}.kind",
+                                   f"kind={kind!r} is not one of "
+                                   f"{PROC_KINDS}"))
+                continue
+            required = {"kind": (str,)}
+            optional = {"preset": (str,), "devices": (int,),
+                        "overrides": (dict,), "args": (list,),
+                        "env": (dict,), "faults": (dict,),
+                        "cwd": (str,)}
+            if kind == "cmd":
+                required["argv"] = (list,)
+            _check_fields(proc, where, required, optional, errors)
+            for k in (proc.get("faults") or {}):
+                if k not in FAULT_KEYS:
+                    errors.append(_err("unknown_fault",
+                                       f"{where}.faults.{k}",
+                                       f"{k!r} is not one of "
+                                       f"{FAULT_KEYS}"))
+            for k, v in (proc.get("overrides") or {}).items():
+                if not isinstance(v, _STR_NUM_BOOL):
+                    errors.append(_err("bad_type",
+                                       f"{where}.overrides.{k}",
+                                       "override values must be "
+                                       "scalars"))
+
+    steps = data.get("steps")
+    if isinstance(steps, list):
+        if not steps:
+            errors.append(_err("empty", "$.steps",
+                               "a scenario needs at least one step"))
+        labels: set = set()
+        for i, step in enumerate(steps):
+            _validate_step(i, step, proc_names, labels, errors)
+
+    for i, a in enumerate(data.get("assertions") or []):
+        if not isinstance(a, dict):
+            errors.append(_err("bad_type", f"$.assertions[{i}]",
+                               "assertion must be an object"))
+            continue
+        _validate_step(i, dict(a, do="assert"), proc_names, set(),
+                       errors)
+
+    for i, s in enumerate(data.get("series") or []):
+        where = f"$.series[{i}]"
+        if not isinstance(s, dict):
+            errors.append(_err("bad_type", where,
+                               "series entry must be an object"))
+            continue
+        source = s.get("source")
+        if source not in SERIES_SOURCES:
+            errors.append(_err("unknown_source", f"{where}.source",
+                               f"source={source!r} is not one of "
+                               f"{SERIES_SOURCES}"))
+            continue
+        required = dict(_SERIES_REQUIRED[source], source=(str,))
+        _check_fields(s, where, required, _SERIES_OPTIONAL[source],
+                      errors)
+    return errors
+
+
+def resolve_rc(spec) -> Optional[list]:
+    """expect_rc spec → concrete list of acceptable codes, or None for
+    'any'. ``"nonzero"`` is returned as-is (sentinel the conductor
+    checks)."""
+    from tpu_resnet.resilience import exitcodes
+
+    names = {"done": exitcodes.DONE, "drained": exitcodes.DRAINED,
+             "preempt": exitcodes.PREEMPTED,
+             "no_capacity": exitcodes.NO_CAPACITY,
+             "usage_error": exitcodes.USAGE_ERROR}
+    items = spec if isinstance(spec, list) else [spec]
+    if "any" in items:
+        return None
+    out = []
+    for item in items:
+        if item == "nonzero":
+            out.append("nonzero")
+        elif isinstance(item, str):
+            out.append(names[item])
+        else:
+            out.append(int(item))
+    return out
+
+
+def expand_templates(obj, run_dir: str, root: str):
+    """Recursively substitute ``{run}``/``{root}``/``{python}`` in every
+    string of the (validated) scenario. Plain ``str.replace`` — scenario
+    files legitimately hold other braces (JSON-in-args for sweep
+    spaces), so ``str.format`` would be a trap."""
+    import sys
+
+    if isinstance(obj, str):
+        return (obj.replace("{run}", run_dir).replace("{root}", root)
+                .replace("{python}", sys.executable))
+    if isinstance(obj, list):
+        return [expand_templates(v, run_dir, root) for v in obj]
+    if isinstance(obj, dict):
+        return {k: expand_templates(v, run_dir, root)
+                for k, v in obj.items()}
+    return obj
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

@@ -1010,12 +1010,12 @@ def route(cfg: RunConfig) -> int:
     """CLI entry: start the router, announce route.json, block until
     SIGTERM/SIGINT (flag-only ShutdownCoordinator — the PR-4
     signal-safety contract), stop accepting, exit 0."""
-    from tpu_resnet.resilience import ShutdownCoordinator
+    from tpu_resnet.resilience import ShutdownCoordinator, exitcodes
 
     if not cfg.route.replicas and not cfg.route.discover_dir:
         log.error("route: need route.replicas=[urls...] or "
                   "route.discover_dir=<dir with serve*.json>")
-        return 2
+        return exitcodes.USAGE_ERROR
     coordinator = ShutdownCoordinator(
         enabled=cfg.resilience.graceful_shutdown,
         action_desc="stopping the router (new predicts get 503, "
@@ -1049,4 +1049,4 @@ def route(cfg: RunConfig) -> int:
         finally:
             router.close()
     log.info("route: exited cleanly")
-    return 0
+    return exitcodes.DONE

@@ -502,6 +502,17 @@ class PredictServer:
                     self._send(400, {"error": "empty body"})
                     return
                 body = self.rfile.read(length)
+                if server._injector.should_drop_connection():
+                    # One-shot connection-drop fault (faultinject
+                    # SERVE_DROP_REQ): slam the socket with no HTTP
+                    # response — the abrupt RemoteDisconnected the
+                    # router's retry-once failover must absorb.
+                    self.close_connection = True
+                    try:
+                        self.connection.close()
+                    except OSError:
+                        pass
+                    return
                 trace_id = (self.headers.get("X-Trace-Id") or "").strip()
                 code, payload = server.handle_predict(
                     body, self.headers.get("Content-Type", ""),
@@ -570,11 +581,11 @@ def serve(cfg: RunConfig) -> int:
     if cfg.serve.admission_hbm_bytes > 0:
         # Colocation admission (resilience/elastic.py): a replica
         # joining a trainer's host starts only when the live HBM gauges
-        # say its estimated footprint fits the measured headroom. Exit
-        # code 3 is the scheduler-facing "no capacity here" — distinct
-        # from a crash, so a placement loop tries another host instead
-        # of backing off on this one.
-        from tpu_resnet.resilience import elastic
+        # say its estimated footprint fits the measured headroom.
+        # NO_CAPACITY is the scheduler-facing "no capacity here" —
+        # distinct from a crash, so a placement loop tries another host
+        # instead of backing off on this one.
+        from tpu_resnet.resilience import elastic, exitcodes
 
         verdict = elastic.colocation_admission(cfg.serve.admission_hbm_bytes)
         spans.event("colocation_admission", **verdict)
@@ -582,7 +593,7 @@ def serve(cfg: RunConfig) -> int:
             log.error("serve: colocation admission denied — %s",
                       verdict["reason"])
             spans.close()
-            return 3
+            return exitcodes.NO_CAPACITY
         log.info("serve: colocation admission ok — %s", verdict["reason"])
     server = PredictServer(cfg, spans=spans)
     clean = True

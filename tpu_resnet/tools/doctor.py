@@ -321,124 +321,81 @@ def _check_static_analysis(matrix: bool = True, timeout: int = 900) -> dict:
         return out
 
 
+def _run_scenario(name: str):
+    """Conduct a checked-in ``scenarios/<name>.json`` file and index its
+    step/assertion entries by label — the raw material every
+    scenario-backed probe below rebuilds its historical DOCTOR_JSON
+    dict from. The conductor owns the skeleton (scrubbed children,
+    fault env, log files, reaper, survivor kill); the probe adapters
+    own only the legacy output shape."""
+    from tpu_resnet.scenario.catalog import scenario_path
+    from tpu_resnet.scenario.conductor import conduct_file
+
+    result = conduct_file(scenario_path(name))
+    return result, {s["label"]: s for s in result.get("steps", [])}
+
+
+def _scenario_fail(result: dict) -> dict:
+    """Failed scenario → the historical probe failure dict: phase,
+    error (when the step carried one), every observation (run spans as
+    the legacy tuples), the child's log tail."""
+    failed = (result.get("steps") or [{}])[-1]
+    out = {"ok": False, "phase": result.get("phase")}
+    if failed.get("error") or result.get("error"):
+        out["error"] = failed.get("error") or result.get("error")
+    for key, value in (failed.get("observed") or {}).items():
+        if key == "run_spans":
+            value = [tuple(s) for s in value]
+        out[key] = value
+    if failed.get("tail") is not None:
+        out["tail"] = failed["tail"]
+    return out
+
+
+def _scenario_perfwatch(result: dict, out: dict) -> bool:
+    """Fold the conductor's perfwatch verdict into a legacy probe dict.
+    Returns True when the caller should return ``out`` as-is (hung or
+    failed ingestion — the historical early-return paths); the legacy
+    key spellings (``perfwatch="hung"``, ``perfwatch_ingested``,
+    ``perfwatch_tail``) are preserved."""
+    pw = result.get("perfwatch") or {}
+    if pw.get("hung"):
+        out.update(ok=False, perfwatch="hung")
+        return True
+    if not pw.get("ran"):
+        out["perfwatch_ingested"] = (
+            "skipped (no tools/perfwatch.py)"
+            if pw.get("reason") == "no tools/perfwatch.py"
+            else "skipped (no throughput samples)")
+        return False
+    ingested = all((pw.get("ingested") or {}).values())
+    out["perfwatch_ingested"] = ingested
+    if pw.get("rc") != 0 or not ingested:
+        out.update(ok=False, phase="perfwatch",
+                   perfwatch_tail=pw.get("tail", []))
+        return True
+    return False
+
+
 def _check_serve_probe(timeout: int = 300) -> dict:
     """Live predict-server drill (tpu_resnet/serve) in scrubbed CPU
     subprocesses: train a tiny MLP, start ``tpu_resnet serve`` on an
     ephemeral port, wait for /healthz readiness (model loaded + every
     bucket compiled), fire a handful of predict requests, scrape
     /metrics, then SIGTERM and verify the graceful-drain exit-code
-    contract (0 — the supervisor-facing analog of the trainer's 42)."""
-    import signal
-    import tempfile
-    import time
-    import urllib.error
-    import urllib.request
+    contract (0 — the supervisor-facing analog of the trainer's 42).
 
-    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
-    from tpu_resnet.obs.server import parse_prometheus
-
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_serve_") as d:
-        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
-                     "--preset", "smoke", f"train.train_dir={d}",
-                     "train.train_steps=6", "train.checkpoint_every=3",
-                     "train.log_every=3", "train.summary_every=6",
-                     "train.image_summary_every=0",
-                     "train.steps_per_call=3", "model.name=mlp",
-                     "data.device_resident=off", "data.transfer_stage=1"]
-        rc, out = run_scrubbed_subprocess(train_cmd, n_devices=1,
-                                          timeout=timeout)
-        if rc != 0:
-            return {"ok": False, "phase": "train", "rc": rc,
-                    "tail": out.strip().splitlines()[-5:]}
-        serve_cmd = [sys.executable, "-m", "tpu_resnet", "serve",
-                     "--preset", "smoke", f"train.train_dir={d}",
-                     "model.name=mlp", "data.device_resident=off",
-                     "serve.port=0", "serve.max_batch=4",
-                     "serve.max_wait_ms=5"]
-        # Child output goes to a FILE, not a pipe: nobody reads while we
-        # wait on the server, and a chatty child against a full 64K pipe
-        # would deadlock proc.wait() after SIGTERM.
-        log_path = os.path.join(d, "serve_child.log")
-        log_fh = open(log_path, "w")
-
-        def _tail():
-            log_fh.flush()
-            try:
-                with open(log_path) as f:
-                    return f.read().strip().splitlines()[-5:]
-            except OSError:
-                return []
-
-        proc = subprocess.Popen(serve_cmd, env=scrubbed_cpu_env(1),
-                                stdout=log_fh,
-                                stderr=subprocess.STDOUT, text=True)
-        try:
-            from tpu_resnet.serve.server import read_serve_port
-
-            base, ready = None, False
-            deadline = time.time() + timeout
-            while time.time() < deadline and proc.poll() is None:
-                if base is None:
-                    port = read_serve_port(d)
-                    if port is not None:
-                        base = f"http://127.0.0.1:{port}"
-                if base is not None:
-                    try:
-                        with urllib.request.urlopen(base + "/healthz",
-                                                    timeout=2) as r:
-                            if json.loads(r.read()).get("ok"):
-                                ready = True
-                                break
-                    except (OSError, ValueError):
-                        pass  # 503 (warming) / not listening yet
-                time.sleep(0.3)
-            if not ready:
-                proc.kill()
-                proc.wait(timeout=10)
-                return {"ok": False, "phase": "readiness",
-                        "rc": proc.returncode, "tail": _tail()}
-            ok_requests = 0
-            body = bytes(2 * 32 * 32 * 3)  # two zero CIFAR-shaped images
-            for _ in range(5):
-                req = urllib.request.Request(
-                    base + "/predict", data=body,
-                    headers={"Content-Type": "application/octet-stream",
-                             "X-Shape": "2,32,32,3"})
-                try:
-                    with urllib.request.urlopen(req, timeout=60) as r:
-                        payload = json.loads(r.read())
-                    if len(payload.get("predictions", [])) == 2:
-                        ok_requests += 1
-                except (OSError, ValueError):
-                    pass
-            try:
-                with urllib.request.urlopen(base + "/metrics",
-                                            timeout=10) as r:
-                    metrics = parse_prometheus(r.read().decode())
-                served = int(metrics.get("tpu_resnet_serve_requests_total",
-                                         0))
-            except (OSError, ValueError):
-                # A dead/died server is a FAILED check with a tail, not a
-                # doctor crash (every other urlopen here is guarded too).
-                served = -1
-            proc.send_signal(signal.SIGTERM)
-            try:
-                rc2 = proc.wait(timeout=60)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                return {"ok": False, "phase": "drain",
-                        "error": "server did not exit within 60s of "
-                                 "SIGTERM"}
-            result = {"ok": ok_requests == 5 and rc2 == 0 and served >= 5,
-                      "requests_ok": ok_requests, "served_total": served,
-                      "drain_rc": rc2}
-            if not result["ok"]:
-                result["tail"] = _tail()
-            return result
-        finally:
-            if proc.poll() is None:
-                proc.kill()
-            log_fh.close()
+    Thin alias over ``scenarios/serve_probe.json`` — the scenario
+    conductor runs the drill; this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("serve_probe")
+    if not result["ok"]:
+        return _scenario_fail(result)
+    return {"ok": True,
+            "requests_ok": steps["predict"]["observed"]["ok_requests"],
+            "served_total": int(
+                steps["served"]["observed"]["served_total"]),
+            "drain_rc": result["rcs"]["serve"]}
 
 
 def _check_coldstart_probe(timeout: int = 600) -> dict:
@@ -1271,108 +1228,28 @@ def _check_trace_probe(timeout: int = 300) -> dict:
     train_dir and schema-check the merged Chrome trace — run_id in the
     trace must match the manifest's. Proves the whole performance-
     observability chain (gauges → histograms → spans → timeline) on this
-    machine in one check."""
-    import signal
-    import tempfile
-    import time
+    machine in one check.
 
-    from tpu_resnet.hostenv import scrubbed_cpu_env
-    from tpu_resnet.obs.server import (parse_histograms, parse_prometheus,
-                                       read_telemetry_port)
-    from tpu_resnet.obs.trace import export_trace
-    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
+    Thin alias over ``scenarios/trace_probe.json`` — the scenario
+    conductor runs the drill; this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("trace_probe")
+    live = (steps.get("live") or {}).get("observed") or {}
 
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_trace_") as d:
-        train_cmd = [sys.executable, "-m", "tpu_resnet", "train",
-                     "--preset", "smoke", f"train.train_dir={d}",
-                     "train.train_steps=2000", "train.log_every=2",
-                     "train.summary_every=2", "train.checkpoint_every=50",
-                     "train.image_summary_every=0",
-                     "train.steps_per_call=2", "train.telemetry_port=0",
-                     "model.name=mlp", "data.device_resident=off",
-                     "data.transfer_stage=1"]
-        env = scrubbed_cpu_env(1)
-        # A known per-chip peak makes the mfu gauge genuinely nonzero on
-        # CPU — the probe then checks LIVE utilization accounting, not
-        # just series presence. (BENCH_, not TPU_: the scrub strips TPU_*.)
-        env["BENCH_PEAK_FLOPS"] = "1e12"
-        log_path = os.path.join(d, "trace_probe_child.log")
-        log_fh = open(log_path, "w")
+    def _shaped(obs, ok):
+        return {"ok": ok, "run_id": obs.get("run_id"),
+                "trace_events": obs.get("trace_events", 0),
+                "preempt_rc": result["rcs"].get("train"), **live}
 
-        def _tail():
-            log_fh.flush()
-            try:
-                with open(log_path) as f:
-                    return f.read().strip().splitlines()[-5:]
-            except OSError:
-                return []
-
-        proc = subprocess.Popen(train_cmd, env=env, stdout=log_fh,
-                                stderr=subprocess.STDOUT, text=True)
-        try:
-            import urllib.request
-
-            live = {}
-            deadline = time.time() + timeout
-            while time.time() < deadline and proc.poll() is None:
-                port = read_telemetry_port(d)
-                if port is not None:
-                    try:
-                        with urllib.request.urlopen(
-                                f"http://127.0.0.1:{port}/metrics",
-                                timeout=2) as r:
-                            text = r.read().decode()
-                        metrics = parse_prometheus(text)
-                        hists = parse_histograms(text)
-                        if (metrics.get("tpu_resnet_mfu", 0) > 0
-                                and hists.get("tpu_resnet_train_step_ms",
-                                              {}).get("count", 0) > 0):
-                            live = {
-                                "mfu": metrics["tpu_resnet_mfu"],
-                                "model_flops_per_sec": metrics.get(
-                                    "tpu_resnet_model_flops_per_sec"),
-                                "step_ms_observations": hists[
-                                    "tpu_resnet_train_step_ms"]["count"],
-                            }
-                            break
-                    except (OSError, ValueError):
-                        pass  # not listening yet / mid-write
-                time.sleep(0.3)
-            if not live:
-                proc.kill()
-                proc.wait(timeout=10)
-                return {"ok": False, "phase": "live_scrape",
-                        "error": "mfu gauge / train_step_ms histogram "
-                                 "never went live", "tail": _tail()}
-            proc.send_signal(signal.SIGTERM)
-            try:
-                rc = proc.wait(timeout=120)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                return {"ok": False, "phase": "preempt",
-                        "error": "trainer did not exit within 120s of "
-                                 "SIGTERM", "tail": _tail()}
-            if rc not in (0, PREEMPT_EXIT_CODE):
-                return {"ok": False, "phase": "preempt", "rc": rc,
-                        "tail": _tail()}
-            try:
-                path, trace = export_trace(d)
-            except (OSError, ValueError) as e:
-                return {"ok": False, "phase": "trace_export",
-                        "error": f"{type(e).__name__}: {e}"}
-            with open(os.path.join(d, "manifest.json")) as f:
-                manifest_run_id = json.load(f).get("run_id")
-            ok = (manifest_run_id is not None
-                  and trace["metadata"]["run_id"] == manifest_run_id)
-            span_names = {e["name"] for e in trace["traceEvents"]}
-            return {"ok": ok and {"run", "compile"} <= span_names,
-                    "run_id": manifest_run_id,
-                    "trace_events": len(trace["traceEvents"]),
-                    "preempt_rc": rc, **live}
-        finally:
-            if proc.poll() is None:
-                proc.kill()
-            log_fh.close()
+    if not result["ok"]:
+        failed = (result.get("steps") or [{}])[-1]
+        # A run_id/span mismatch after a successful export is the
+        # historical success-shaped ok=False dict, not a phase failure.
+        if (result.get("phase") == "trace_export"
+                and "run_id" in (failed.get("observed") or {})):
+            return _shaped(failed["observed"], False)
+        return _scenario_fail(result)
+    return _shaped(steps["trace"]["observed"], True)
 
 
 def _check_perfwatch() -> dict:
@@ -1422,73 +1299,35 @@ def _check_sweep_probe(timeout: int = 300) -> dict:
     RESULT_JSON trajectory is COMPLETE (every declared point has a
     status; a lost point is the BENCH_r04 failure mode), and
     ``tools/perfwatch.py --sweep`` must ingest the artifact. Proves the
-    sweep rig on this machine before a chip campaign bets on it."""
-    import tempfile
+    sweep rig on this machine before a chip campaign bets on it.
 
-    from tpu_resnet.hostenv import scrubbed_cpu_env
+    Thin alias over ``scenarios/sweep_probe.json`` — the scenario
+    conductor runs the drill; this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    from tpu_resnet.resilience.exitcodes import HOSTENV_TIMEOUT
 
-    space = {"transfer_stage": [1, 2], "donate": [True], "prefetch": [2],
-             "h2d": [True], "batch": [16], "xla_flags": [""],
-             "fused": [False], "remat": [False]}
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_sweep_") as d:
-        out_json = os.path.join(d, "sweep.json")
-        cmd = [sys.executable, "-m", "tpu_resnet.tools.sweep",
-               "--space", json.dumps(space), "--model", "mlp",
-               "--split", "256", "--warmup", "1", "--measure", "4",
-               "--out", os.path.join(d, "points.jsonl"),
-               "--json", out_json, "--budget", str(timeout - 60),
-               "--point-timeout", "120", "--point-est", "10"]
-        try:
-            proc = subprocess.run(cmd, env=scrubbed_cpu_env(2), cwd=d,
-                                  stdout=subprocess.PIPE,
-                                  stderr=subprocess.STDOUT, text=True,
-                                  timeout=timeout)
-        except subprocess.TimeoutExpired:
-            return {"ok": False, "error": f"sweep hung for {timeout}s"}
-        try:
-            with open(out_json) as f:
-                trajectory = json.load(f)
-        except (OSError, ValueError):
-            return {"ok": False, "rc": proc.returncode,
-                    "error": "no trajectory JSON written",
-                    "tail": proc.stdout.strip().splitlines()[-5:]}
-        points = {p.get("id"): p for p in trajectory.get("points", [])}
-        complete = set(points) == {"base", "transfer_stage=2"}
-        all_ok = all(p.get("status") == "ok" for p in points.values())
-        deadline_honored = all(
-            p.get("deadline_margin_sec", -1) > 0 for p in points.values()
-            if p.get("status") == "ok")
-        out = {"ok": bool(complete and all_ok and deadline_honored),
-               "rc": proc.returncode, "complete": complete,
-               "statuses": {k: p.get("status")
-                            for k, p in points.items()},
-               "deadline_honored": deadline_honored}
-        # perfwatch must be able to cohort the artifact (the satellite
-        # contract: sweep output round-trips through the regression
-        # tracker). Skipped on an installed wheel without tools/.
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        script = os.path.join(root, "tools", "perfwatch.py")
-        if os.path.exists(script):
-            try:
-                pw = subprocess.run(
-                    [sys.executable, script, "--sweep", out_json],
-                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                    text=True, timeout=60)
-            except subprocess.TimeoutExpired:
-                out.update(ok=False, perfwatch="hung")
-                return out
-            ingested = all(f"sweep:{pid}" in pw.stdout for pid in points)
-            out["perfwatch_ingested"] = ingested
-            out["ok"] = out["ok"] and pw.returncode == 0 and ingested
-            if not ingested:
-                out["perfwatch_tail"] = \
-                    pw.stdout.strip().splitlines()[-5:]
-        else:
-            out["perfwatch_ingested"] = "skipped (no tools/perfwatch.py)"
-        if not out["ok"]:
-            out["tail"] = proc.stdout.strip().splitlines()[-5:]
+    result, steps = _run_scenario("sweep_probe")
+    rc = result["rcs"].get("sweep")
+    sweep_tail = (steps.get("sweep") or {}).get("tail", [])
+    if rc == HOSTENV_TIMEOUT:
+        return {"ok": False, "error": f"sweep hung for {timeout}s"}
+    traj = (steps.get("trajectory") or {}).get("observed") or {}
+    if "complete" not in traj:
+        return {"ok": False, "rc": rc,
+                "error": "no trajectory JSON written",
+                "tail": sweep_tail}
+    out = {"ok": bool(steps["trajectory"].get("ok")), "rc": rc,
+           "complete": traj["complete"], "statuses": traj["statuses"],
+           "deadline_honored": traj["deadline_honored"]}
+    if (result.get("perfwatch") or {}).get("hung"):
+        out.update(ok=False, perfwatch="hung")
         return out
+    if _scenario_perfwatch(result, out):
+        # The historical sweep shape carried perfwatch_tail, not a phase.
+        out.pop("phase", None)
+    if not out["ok"]:
+        out["tail"] = sweep_tail
+    return out
 
 
 def _check_mem_probe(timeout: int = 300) -> dict:
@@ -1507,145 +1346,29 @@ def _check_mem_probe(timeout: int = 300) -> dict:
        (resilience.inject_oom_at_step) — the crash must leave a
        schema-valid ``oom_report.json`` carrying a live-array census,
        and the child must still die loudly (forensics never swallow the
-       OOM)."""
-    import signal
-    import tempfile
-    import time
-    import urllib.request
+       OOM).
 
-    from tpu_resnet.hostenv import run_scrubbed_subprocess, scrubbed_cpu_env
-    from tpu_resnet.obs.memory import validate_oom_report
-    from tpu_resnet.obs.server import parse_prometheus, read_telemetry_port
-    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
-
-    gauge_series = ("tpu_resnet_hbm_bytes_in_use",
-                    "tpu_resnet_hbm_bytes_peak")
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_mem_") as d:
-        base = [sys.executable, "-m", "tpu_resnet", "train",
-                "--preset", "smoke", f"train.train_dir={d}",
-                "train.train_steps=2000", "train.log_every=2",
-                "train.summary_every=2", "train.checkpoint_every=50",
-                "train.image_summary_every=0", "train.steps_per_call=2",
-                "train.telemetry_port=0", "model.name=mlp",
-                "data.device_resident=off", "data.transfer_stage=1"]
-        log_path = os.path.join(d, "mem_probe_child.log")
-        log_fh = open(log_path, "w")
-
-        def _tail():
-            log_fh.flush()
-            try:
-                with open(log_path) as f:
-                    return f.read().strip().splitlines()[-5:]
-            except OSError:
-                return []
-
-        proc = subprocess.Popen(base, env=scrubbed_cpu_env(1),
-                                stdout=log_fh, stderr=subprocess.STDOUT,
-                                text=True)
-        try:
-            live = {}
-            deadline = time.time() + timeout
-            while time.time() < deadline and proc.poll() is None:
-                port = read_telemetry_port(d)
-                if port is not None:
-                    try:
-                        with urllib.request.urlopen(
-                                f"http://127.0.0.1:{port}/metrics",
-                                timeout=2) as r:
-                            metrics = parse_prometheus(r.read().decode())
-                        if (all(s in metrics for s in gauge_series)
-                                and os.path.exists(
-                                    os.path.join(d, "memory.json"))):
-                            live = {s: metrics[s] for s in gauge_series}
-                            break
-                    except (OSError, ValueError):
-                        pass  # not listening yet / mid-write
-                time.sleep(0.3)
-            if not live:
-                proc.kill()
-                proc.wait(timeout=10)
-                return {"ok": False, "phase": "live_scrape",
-                        "error": "hbm gauge series / memory.json never "
-                                 "went live", "tail": _tail()}
-            proc.send_signal(signal.SIGTERM)
-            try:
-                rc = proc.wait(timeout=120)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-                return {"ok": False, "phase": "preempt",
-                        "error": "trainer did not exit within 120s of "
-                                 "SIGTERM", "tail": _tail()}
-            if rc not in (0, PREEMPT_EXIT_CODE):
-                return {"ok": False, "phase": "preempt", "rc": rc,
-                        "tail": _tail()}
-        finally:
-            if proc.poll() is None:
-                proc.kill()
-            log_fh.close()
-
-        try:
-            with open(os.path.join(d, "memory.json")) as f:
-                ledger = json.load(f).get("entries", {})
-        except (OSError, ValueError) as e:
-            return {"ok": False, "phase": "ledger",
-                    "error": f"memory.json unreadable: {e}"}
-        bad = [k for k, e in ledger.items()
-               if not (e.get("argument_bytes", 0) > 0
-                       and e.get("temp_bytes", 0) > 0
-                       and e.get("alias_bytes", 0) > 0)]
-        if not ledger or bad:
-            return {"ok": False, "phase": "ledger", "entries": list(ledger),
-                    "missing_bytes": bad,
-                    "error": "ledger empty or missing nonzero argument/"
-                             "temp/alias (donation) bytes"}
-        try:
-            with open(os.path.join(d, "flops.json")) as f:
-                flops_keys = sorted(json.load(f).get("entries", {}))
-        except (OSError, ValueError) as e:
-            return {"ok": False, "phase": "ledger",
-                    "error": f"flops.json unreadable: {e}"}
-        if sorted(ledger) != flops_keys:
-            return {"ok": False, "phase": "ledger",
-                    "error": "memory.json and flops.json certify "
-                             "different program keys",
-                    "memory_keys": sorted(ledger),
-                    "flops_keys": flops_keys}
-
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_oom_") as d:
-        rc_oom, out = run_scrubbed_subprocess(
-            [sys.executable, "-m", "tpu_resnet", "train",
-             "--preset", "smoke", f"train.train_dir={d}",
-             "train.train_steps=40", "train.log_every=5",
-             "train.summary_every=20", "train.checkpoint_every=50",
-             "train.image_summary_every=0", "train.steps_per_call=5",
-             "train.telemetry_port=-1", "model.name=mlp",
-             "data.device_resident=off", "data.transfer_stage=1",
-             "resilience.inject_oom_at_step=10"],
-            n_devices=1, timeout=timeout)
-        if rc_oom == 0:
+    Thin alias over ``scenarios/mem_probe.json`` — the scenario
+    conductor runs both children; this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("mem_probe")
+    if not result["ok"]:
+        failed = (result.get("steps") or [{}])[-1]
+        # An exit code of 0 from the OOM child is the one failure whose
+        # historical wording names the contract, not the rc.
+        if failed.get("label") == "oom_run":
             return {"ok": False, "phase": "oom",
                     "error": "injected RESOURCE_EXHAUSTED did not fail "
                              "the run (forensics must re-raise)",
-                    "tail": out.strip().splitlines()[-5:]}
-        report_path = os.path.join(d, "oom_report.json")
-        try:
-            with open(report_path) as f:
-                report = json.load(f)
-        except (OSError, ValueError) as e:
-            return {"ok": False, "phase": "oom",
-                    "error": f"oom_report.json unreadable: {e}",
-                    "tail": out.strip().splitlines()[-5:]}
-        problems = validate_oom_report(report)
-        census = (report.get("live_arrays") or {})
-        if not census.get("total_arrays"):
-            problems.append("live-array census is empty")
-        if problems:
-            return {"ok": False, "phase": "oom", "problems": problems}
-        return {"ok": True, **live,
-                "ledger_keys": flops_keys,
-                "oom_rc": rc_oom,
-                "oom_census_buckets": len(census.get("buckets", [])),
-                "oom_census_bytes": census.get("total_bytes")}
+                    "tail": failed.get("tail", [])}
+        return _scenario_fail(result)
+    oom = steps["oom"]["observed"]
+    return {"ok": True, **steps["live"]["observed"],
+            "ledger_keys": steps["ledger_keys"]["observed"]
+            ["ledger_keys"],
+            "oom_rc": result["rcs"]["train_oom"],
+            "oom_census_buckets": oom["oom_census_buckets"],
+            "oom_census_bytes": oom["oom_census_bytes"]}
 
 
 def _check_partition_probe(timeout: int = 420) -> dict:
@@ -1665,123 +1388,30 @@ def _check_partition_probe(timeout: int = 420) -> dict:
        with generous slack) with the donation credit intact;
     4. ``tools/perfwatch.py --sweep`` must ingest both runs' peak-HBM
        numbers as the lower-is-better ``sweep-mem:`` series, so the
-       memory win is a TRACKED trajectory, not a one-shot assertion."""
-    import tempfile
+       memory win is a TRACKED trajectory, not a one-shot assertion.
 
-    from tpu_resnet.hostenv import run_scrubbed_subprocess
-    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
-
-    overrides = ["train.train_steps=40", "train.checkpoint_every=10",
-                 "train.log_every=10", "train.summary_every=20",
-                 "train.image_summary_every=0", "train.steps_per_call=5",
-                 "train.global_batch_size=16", "model.name=mlp",
-                 "data.device_resident=off", "data.transfer_stage=1"]
-
-    def _ledger_entry(d):
-        with open(os.path.join(d, "memory.json")) as f:
-            entries = json.load(f).get("entries", {})
-        for key, e in sorted(entries.items()):
-            if "opt_state_argument_bytes" in e:
-                return key, e
-        return None, None
-
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_part_") as d:
-        rep_dir = os.path.join(d, "replicated")
-        z_dir = os.path.join(d, "zero1")
-        rc_rep, out = run_scrubbed_subprocess(
-            [sys.executable, "-m", "tpu_resnet", "train",
-             "--preset", "smoke", f"train.train_dir={rep_dir}"]
-            + overrides, n_devices=8, timeout=timeout)
-        if rc_rep != 0:
-            return {"ok": False, "phase": "replicated", "rc": rc_rep,
-                    "tail": out.strip().splitlines()[-5:]}
-        zcmd = [sys.executable, "-m", "tpu_resnet", "train",
-                "--preset", "smoke", f"train.train_dir={z_dir}",
-                "mesh.partition=zero1"] + overrides
-        rc1, out1 = run_scrubbed_subprocess(
-            zcmd + ["resilience.inject_sigterm_at_step=20"],
-            n_devices=8, timeout=timeout)
-        # z_dir is created by the CHILD (first artifact write): a child
-        # that dies at startup — a partitioner regression raising before
-        # any directory exists — must be a structured failure report,
-        # not a doctor FileNotFoundError.
-        steps = (sorted(int(n) for n in os.listdir(z_dir) if n.isdigit())
-                 if os.path.isdir(z_dir) else [])
-        if rc1 != PREEMPT_EXIT_CODE or 20 not in steps:
-            return {"ok": False, "phase": "zero1_preempt", "rc": rc1,
-                    "expected_rc": PREEMPT_EXIT_CODE, "ckpt_steps": steps,
-                    "tail": out1.strip().splitlines()[-5:]}
-        rc2, out2 = run_scrubbed_subprocess(zcmd, n_devices=8,
-                                            timeout=timeout)
-        if rc2 != 0:
-            return {"ok": False, "phase": "zero1_resume", "rc": rc2,
-                    "tail": out2.strip().splitlines()[-5:]}
-        try:
-            rep_key, rep = _ledger_entry(rep_dir)
-            z_key, z = _ledger_entry(z_dir)
-        except (OSError, ValueError) as e:
-            return {"ok": False, "phase": "ledger",
-                    "error": f"memory.json unreadable: {e}"}
-        if rep is None or z is None:
-            return {"ok": False, "phase": "ledger",
-                    "error": "ledger entry with the optimizer-slot "
-                             "breakdown missing",
-                    "replicated_key": rep_key, "zero1_key": z_key}
-        rep_opt = int(rep.get("opt_state_argument_bytes", 0))
-        z_opt = int(z.get("opt_state_argument_bytes", 0))
-        ratio = z_opt / rep_opt if rep_opt else float("inf")
-        result = {"replicated_key": rep_key, "zero1_key": z_key,
-                  "opt_bytes_replicated": rep_opt,
-                  "opt_bytes_zero1": z_opt,
-                  "opt_ratio": round(ratio, 4),
-                  "zero1_alias_bytes": int(z.get("alias_bytes", 0)),
-                  "preempt_rc": rc1, "resume_rc": rc2,
-                  "ckpt_at_stop": 20}
-        if not (0 < z_opt and ratio < 0.3 and z.get("alias_bytes", 0) > 0):
-            result.update(ok=False, phase="opt_bytes",
-                          error="zero1 optimizer-slot argument bytes not "
-                                "< 0.3x the replicated twin's with "
-                                "donation intact")
-            return result
-
-        # perfwatch ingestion: the probe's peak-HBM per partition mode as
-        # a sweep-style trajectory — perfwatch's sweep-mem machinery then
-        # tracks it lower-is-better across probe runs. Skipped on an
-        # installed wheel without tools/.
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        script = os.path.join(root, "tools", "perfwatch.py")
-        if os.path.exists(script):
-            traj = {"metric": "partition_probe_hbm", "backend": "cpu",
-                    "points": [
-                        {"id": f"partition={name}", "status": "ok",
-                         "backend": "cpu", "steps_per_sec": 1.0,
-                         "hbm_bytes_peak": int(e.get("peak_bytes", 0))}
-                        for name, e in (("replicated", rep), ("zero1", z))
-                        if e.get("peak_bytes")]}
-            traj_path = os.path.join(d, "partition_probe_sweep.json")
-            with open(traj_path, "w") as f:
-                json.dump(traj, f)
-            try:
-                pw = subprocess.run(
-                    [sys.executable, script, "--sweep", traj_path],
-                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                    text=True, timeout=60)
-            except subprocess.TimeoutExpired:
-                result.update(ok=False, perfwatch="hung")
-                return result
-            ingested = all(f"sweep-mem:partition={n}" in pw.stdout
-                           for n in ("replicated", "zero1"))
-            result["perfwatch_ingested"] = ingested
-            if pw.returncode != 0 or not ingested:
-                result.update(ok=False, phase="perfwatch",
-                              perfwatch_tail=pw.stdout.strip()
-                              .splitlines()[-5:])
-                return result
-        else:
-            result["perfwatch_ingested"] = "skipped (no tools/perfwatch.py)"
-        result["ok"] = True
-        return result
+    Thin alias over ``scenarios/partition_probe.json`` — the scenario
+    conductor runs the three children; this adapter rebuilds the
+    historical DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("partition_probe")
+    if "opt_bytes" not in steps:
+        return _scenario_fail(result)
+    out = dict(steps["opt_bytes"]["observed"])
+    out.update(preempt_rc=result["rcs"].get("zero1_preempt"),
+               resume_rc=result["rcs"].get("zero1_resume"),
+               ckpt_at_stop=20)
+    if not steps["opt_bytes"].get("ok"):
+        # The ratio-check observation is already the historical shape;
+        # a missing opt_state entry is the historical ledger phase.
+        if "opt_bytes_zero1" not in out:
+            return _scenario_fail(dict(result, phase="ledger"))
+        out.update(ok=False, phase="opt_bytes",
+                   error=steps["opt_bytes"].get("error"))
+        return out
+    if _scenario_perfwatch(result, out):
+        return out
+    out["ok"] = True
+    return out
 
 
 def _check_reshape_drill(timeout: int = 480) -> dict:
@@ -1805,184 +1435,63 @@ def _check_reshape_drill(timeout: int = 480) -> dict:
     4. ``tools/perfwatch.py --sweep`` must ingest the drill's pre/post
        steps/s (post normalized by the 8/4 device ratio) — a reshape
        that silently loses throughput beyond the device ratio becomes a
-       TRACKED regression, not folklore."""
-    import tempfile
+       TRACKED regression, not folklore.
 
-    from tpu_resnet.hostenv import run_scrubbed_subprocess
-    from tpu_resnet.obs.spans import load_jsonl, load_spans
-    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
-
-    overrides = ["train.train_steps=40", "train.checkpoint_every=10",
-                 "train.log_every=5", "train.summary_every=5",
-                 "train.image_summary_every=0", "train.steps_per_call=5",
-                 "train.global_batch_size=16", "model.name=mlp",
-                 "data.device_resident=off", "data.transfer_stage=1"]
-
-    def _metrics(d):
-        return load_jsonl(os.path.join(d, "metrics.jsonl"), "step")
-
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_reshape_") as d:
-        ref_dir = os.path.join(d, "reference")
-        e_dir = os.path.join(d, "elastic")
-        rc_ref, out = run_scrubbed_subprocess(
-            [sys.executable, "-m", "tpu_resnet", "train",
-             "--preset", "smoke", f"train.train_dir={ref_dir}"]
-            + overrides, n_devices=8, timeout=timeout)
-        if rc_ref != 0:
-            return {"ok": False, "phase": "reference", "rc": rc_ref,
-                    "tail": out.strip().splitlines()[-5:]}
-        ecmd = [sys.executable, "-m", "tpu_resnet", "train",
-                "--preset", "smoke", f"train.train_dir={e_dir}"] + overrides
-        rc1, out1 = run_scrubbed_subprocess(
-            ecmd + ["resilience.inject_sigterm_at_step=20"],
-            n_devices=8, timeout=timeout)
-        steps = (sorted(int(n) for n in os.listdir(e_dir) if n.isdigit())
-                 if os.path.isdir(e_dir) else [])
-        if rc1 != PREEMPT_EXIT_CODE or 20 not in steps:
-            return {"ok": False, "phase": "preempt", "rc": rc1,
-                    "expected_rc": PREEMPT_EXIT_CODE, "ckpt_steps": steps,
-                    "tail": out1.strip().splitlines()[-5:]}
-        # The reshape: resume the mesh8/replicated checkpoint in a child
-        # that only HAS 4 devices, as zero1.
-        rc2, out2 = run_scrubbed_subprocess(
-            ecmd + ["mesh.partition=zero1"], n_devices=4, timeout=timeout)
-        if rc2 != 0:
-            return {"ok": False, "phase": "reshape_resume", "rc": rc2,
-                    "tail": out2.strip().splitlines()[-5:]}
-
-        ref_loss = {r["step"]: r["loss"] for r in _metrics(ref_dir)
-                    if "loss" in r}
-        e_recs = _metrics(e_dir)
-        e_loss = {r["step"]: r["loss"] for r in e_recs if "loss" in r}
-        if not ref_loss or set(ref_loss) != set(e_loss):
-            return {"ok": False, "phase": "loss_stream",
-                    "error": "logged steps differ across the reshape",
-                    "reference_steps": sorted(ref_loss),
-                    "elastic_steps": sorted(e_loss)}
-        drift = {s: abs(ref_loss[s] - e_loss[s]) for s in ref_loss}
-        worst = max(drift, key=drift.get)
-        if drift[worst] > 1e-6:
-            return {"ok": False, "phase": "loss_stream",
-                    "error": f"loss stream diverged at step {worst}: "
-                             f"|{ref_loss[worst]} - {e_loss[worst]}| = "
-                             f"{drift[worst]:g} > 1e-6"}
-        reshapes = [s for s in load_spans(os.path.join(e_dir,
-                                                       "events.jsonl"))
-                    if s["span"] == "topology_change"]
-        if not (reshapes
-                and reshapes[-1].get("to_mesh", {}).get("data") == 4
-                and reshapes[-1].get("to_partition") == "zero1"
-                and reshapes[-1].get("from_mesh", {}).get("data") == 8):
+    Thin alias over ``scenarios/reshape_drill.json`` — the scenario
+    conductor runs the three children; this adapter rebuilds the
+    historical DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("reshape_drill")
+    if not result["ok"] and result.get("phase") != "perfwatch":
+        failed = (result.get("steps") or [{}])[-1]
+        observed = failed.get("observed") or {}
+        # Two assertion wordings the historical dict spelled differently
+        # from the scenario checkers' per-attribute messages.
+        if result.get("phase") == "topology_span":
             return {"ok": False, "phase": "topology_span",
                     "error": "topology_change span missing or wrong",
-                    "spans": reshapes}
-        try:
-            with open(os.path.join(e_dir, "topology.json")) as f:
-                topo = json.load(f)
-        except (OSError, ValueError) as e:
-            return {"ok": False, "phase": "topology_record",
-                    "error": f"topology.json unreadable: {e}"}
-        if topo.get("mesh_shape", {}).get("data") != 4 \
-                or topo.get("partition") != "zero1":
+                    "spans": observed.get("spans", [])}
+        if (result.get("phase") == "topology_record"
+                and "artifact" in observed):
             return {"ok": False, "phase": "topology_record",
                     "error": "topology.json does not record the "
-                             "post-reshape shape", "topology": topo}
-
-        pre = [r["steps_per_sec"] for r in e_recs
-               if r.get("steps_per_sec") and r["step"] <= 20]
-        post = [r["steps_per_sec"] for r in e_recs
-                if r.get("steps_per_sec") and r["step"] > 20]
-        result = {"loss_steps": len(ref_loss),
-                  "max_loss_drift": drift[worst],
-                  "preempt_rc": rc1, "resume_rc": rc2,
-                  "reshape": reshapes[-1],
-                  "pre_steps_per_sec": round(sum(pre) / len(pre), 3)
-                  if pre else None,
-                  "post_steps_per_sec": round(sum(post) / len(post), 3)
-                  if post else None}
-        # perfwatch ingestion: pre/post throughput as a sweep-style
-        # trajectory, the post point normalized by the device ratio —
-        # "half the chips" legitimately halves steps/s; losing MORE than
-        # that is the regression the tracker should gate. Skipped on an
-        # installed wheel without tools/.
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        script = os.path.join(root, "tools", "perfwatch.py")
-        if os.path.exists(script) and pre and post:
-            ratio = 8 / 4
-            traj = {"metric": "reshape_drill", "backend": "cpu",
-                    "points": [
-                        {"id": "reshape=mesh8_pre", "status": "ok",
-                         "backend": "cpu",
-                         "steps_per_sec": result["pre_steps_per_sec"]},
-                        {"id": "reshape=mesh4_post", "status": "ok",
-                         "backend": "cpu",
-                         "steps_per_sec": round(
-                             result["post_steps_per_sec"] * ratio, 3)}]}
-            traj_path = os.path.join(d, "reshape_drill_sweep.json")
-            with open(traj_path, "w") as f:
-                json.dump(traj, f)
-            try:
-                pw = subprocess.run(
-                    [sys.executable, script, "--sweep", traj_path],
-                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                    text=True, timeout=60)
-            except subprocess.TimeoutExpired:
-                result.update(ok=False, perfwatch="hung")
-                return result
-            ingested = all(f"sweep:reshape={n}" in pw.stdout
-                           for n in ("mesh8_pre", "mesh4_post"))
-            result["perfwatch_ingested"] = ingested
-            if pw.returncode != 0 or not ingested:
-                result.update(ok=False, phase="perfwatch",
-                              perfwatch_tail=pw.stdout.strip()
-                              .splitlines()[-5:])
-                return result
-        else:
-            result["perfwatch_ingested"] = (
-                "skipped (no tools/perfwatch.py)" if pre and post
-                else "skipped (no throughput samples)")
-        result["ok"] = True
-        return result
+                             "post-reshape shape",
+                    "topology": observed["artifact"]}
+        return _scenario_fail(result)
+    points = {p["id"]: p for p in result.get("series") or []}
+    pre_point = points.get("reshape=mesh8_pre")
+    post_point = points.get("reshape=mesh4_post")
+    out = {"loss_steps": steps["loss_stream"]["observed"]["loss_steps"],
+           "max_loss_drift":
+               steps["loss_stream"]["observed"]["max_loss_drift"],
+           "preempt_rc": result["rcs"].get("elastic_preempt"),
+           "resume_rc": result["rcs"].get("elastic_resume"),
+           "reshape": steps["topology_span"]["observed"]["spans"][-1],
+           "pre_steps_per_sec":
+               pre_point["steps_per_sec"] if pre_point else None,
+           "post_steps_per_sec":
+               post_point.get("raw_value", post_point["steps_per_sec"])
+               if post_point else None}
+    if _scenario_perfwatch(result, out):
+        return out
+    out["ok"] = True
+    return out
 
 
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
-    checkpoint step directories, and the events.jsonl run spans."""
-    import tempfile
+    checkpoint step directories, and the events.jsonl run spans.
 
-    from tpu_resnet.hostenv import run_scrubbed_subprocess
-    from tpu_resnet.obs.spans import load_spans
-    from tpu_resnet.resilience import PREEMPT_EXIT_CODE
-
-    with tempfile.TemporaryDirectory(prefix="tpu_resnet_drill_") as d:
-        base = [sys.executable, "-m", "tpu_resnet", "train",
-                "--preset", "smoke", f"train.train_dir={d}",
-                "train.train_steps=40", "train.checkpoint_every=10",
-                "train.log_every=10", "train.summary_every=20",
-                "train.image_summary_every=0", "train.steps_per_call=5",
-                "model.name=mlp", "data.device_resident=off",
-                "data.transfer_stage=1"]
-        rc1, out1 = run_scrubbed_subprocess(
-            base + ["resilience.inject_sigterm_at_step=20"],
-            n_devices=1, timeout=timeout)
-        steps = sorted(int(n) for n in os.listdir(d) if n.isdigit())
-        if rc1 != PREEMPT_EXIT_CODE or 20 not in steps:
-            return {"ok": False, "phase": "preempt", "rc": rc1,
-                    "expected_rc": PREEMPT_EXIT_CODE, "ckpt_steps": steps,
-                    "tail": out1.strip().splitlines()[-5:]}
-        rc2, out2 = run_scrubbed_subprocess(base, n_devices=1,
-                                            timeout=timeout)
-        runs = [s for s in load_spans(os.path.join(d, "events.jsonl"))
-                if s["span"] == "run"]
-        resumed = [(s.get("start_step"), s.get("stop_step")) for s in runs]
-        if rc2 != 0 or resumed != [(0, 20), (20, 40)]:
-            return {"ok": False, "phase": "resume", "rc": rc2,
-                    "run_spans": resumed,
-                    "tail": out2.strip().splitlines()[-5:]}
-        return {"ok": True, "preempt_rc": rc1, "ckpt_at_stop": 20,
-                "run_spans": resumed}
+    Thin alias over ``scenarios/fault_drill.json`` — the scenario
+    conductor runs both children; this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("fault_drill")
+    if not result["ok"]:
+        return _scenario_fail(result)
+    spans = [tuple(s) for s in
+             steps["resume"]["observed"]["run_spans"]]
+    return {"ok": True, "preempt_rc": result["rcs"]["train_preempt"],
+            "ckpt_at_stop": 20, "run_spans": spans}
 
 
 def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
