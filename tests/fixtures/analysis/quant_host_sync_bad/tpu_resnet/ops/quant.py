@@ -1,0 +1,25 @@
+"""Seeded jit-host-sync violations in the int8 quant module: ops/* is
+jit scope — fake_quant/dequantize_variables trace into every quantized
+serve program, so a host clock, host RNG or device sync here runs once
+at trace time (baking garbage into the compiled bucket program) or
+lands a round-trip in the per-batch serving hot path."""
+
+import time
+
+import jax
+import numpy as np
+
+
+def dequant_leaf_timed(q, scale):
+    t0 = time.monotonic()                 # flagged: host clock under jit
+    w = q.astype(jax.numpy.float32) * scale
+    amax = float(np.abs(jax.device_get(w)).max())  # flagged: device->host
+    if np.random.random() < 0.5:          # flagged: host RNG at trace
+        amax = amax * 1.0
+    print("dequant took", time.monotonic() - t0, amax)  # flagged
+    return w
+
+
+def clean_dequant(q, scale):
+    # Hazard-free function in the same jit-scope file: must stay silent.
+    return q.astype(jax.numpy.float32) * scale

@@ -183,6 +183,23 @@ def test_epilogue_host_sync_fixture():
     assert not any("clean_fold" in f.message for f in found)
 
 
+def test_quant_host_sync_fixture():
+    """ops/quant.py is jit scope (explicitly listed in JIT_SCOPE_FILES
+    on top of the ops/ prefix): fake_quant/dequantize_variables trace
+    into every quantized serve bucket program, so a seeded host clock,
+    host RNG or device round-trip there must be flagged."""
+    from tpu_resnet.analysis.jaxlint import JIT_SCOPE_FILES
+
+    assert "tpu_resnet/ops/quant.py" in JIT_SCOPE_FILES
+    found = fixture_findings("quant_host_sync_bad", "jit-host-sync")
+    msgs = "\n".join(f.format() for f in found)
+    for hazard in ("time.monotonic", "numpy.random", "jax.device_get",
+                   "print"):
+        assert hazard in msgs, f"{hazard} not flagged:\n{msgs}"
+    assert all(f.path == "tpu_resnet/ops/quant.py" for f in found)
+    assert not any("clean_dequant" in f.message for f in found)
+
+
 def test_sweep_measure_host_sync_fixture():
     """tools/sweep_measure.py (the sweep harness's jit-program assembly)
     is jit scope: a host sync baked into the measured programs would

@@ -114,7 +114,7 @@ def test_spell_entry_covers_every_traced_matrix_row():
         if entry.expect_error is not None or entry.builder == "ctor-bn-axis":
             continue
         key = programs.spell_entry(entry)
-        assert key.split("|")[0] in ("train", "chunk")
+        assert key.split("|")[0] in ("train", "chunk", "serve")
         keys.setdefault(key, []).append(entry.name)
     # the only entries allowed to share a key are declared-identical
     # program twins (same_program_as)

@@ -1,0 +1,328 @@
+"""Int8 post-training quantization (ops/quant.py, serve/calibrate.py).
+
+The proof plane for the quantized serve/export arm:
+
+- **math**: symmetric per-output-channel round-trip error is bounded by
+  half a quantization step, all-zero channels reconstruct exactly, and
+  per-channel scales beat the per-tensor alternative on kernels with
+  heterogeneous channel magnitudes (why the scheme is per-channel);
+- **config guards**: unknown ``serve.quantize`` strings and the
+  int8 + per-replica-BN combination are refused (the configmatrix
+  must-raise rows pin the same messages);
+- **calibration**: deterministic — same config twice produces a
+  byte-identical digest-stamped ``calibration.json``; tampering fails
+  the digest check; ``ensure_calibration`` reuses a matching file;
+- **registry**: quantized serve programs spell under the ``_q8`` key
+  family, matrix rows and ``spell`` agree, and training keys never pick
+  up the suffix;
+- **cache**: the quantized bucket executable AOT round-trips through
+  the program cache value-identically (the serve warmup path);
+- **parity**: quantized live inference and the quantized export bundle
+  both hold argmax parity >= 99% and top-1 delta <= 0.5pt against the
+  f32 twin — the acceptance gates in ISSUE/ROADMAP;
+- **golden twins**: ``analysis/golden_memory.json`` carries the
+  serve f32/q8 twin rows with quantized weight-argument bytes <= 0.30x
+  of the f32 twin (the headline memory claim, same pattern as the
+  ZeRO-1 opt-slot twin in test_partition.py).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resnet import programs
+from tpu_resnet.config import load_config
+from tpu_resnet.data.augment import get_augment_fns
+from tpu_resnet.data.cifar import synthetic_data
+from tpu_resnet.models import build_model
+from tpu_resnet.ops import quant
+from tpu_resnet.serve import calibrate
+from tpu_resnet.serve.infer import make_serve_infer
+from tpu_resnet.train import build_schedule, init_state
+
+ANALYSIS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tpu_resnet", "analysis")
+
+
+def _mlp_cfg(**overrides):
+    cfg = load_config("smoke")
+    cfg.model.name = "mlp"
+    for k, v in overrides.items():
+        section, field = k.split(".")
+        setattr(getattr(cfg, section), field, v)
+    return cfg
+
+
+def _mlp_variables():
+    cfg = _mlp_cfg()
+    model = build_model(cfg)
+    sched = build_schedule(cfg.optim, cfg.train)
+    state = init_state(model, cfg.optim, sched, jax.random.PRNGKey(0),
+                       jnp.zeros((1, 32, 32, 3)))
+    return {"params": jax.device_get(state.params),
+            "batch_stats": jax.device_get(state.batch_stats)}
+
+
+def _calibrated_act_max(cfg, images):
+    _, eval_pre = get_augment_fns(cfg.data.dataset)
+    return float(np.max(np.abs(np.asarray(eval_pre(jnp.asarray(images))))))
+
+
+# ------------------------------------------------------------------ math
+def test_round_trip_error_bounded_by_half_a_step():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(3, 3, 8, 16).astype(np.float32))
+    q, scale = quant.quantize_leaf(w)
+    assert q.dtype == jnp.int8
+    assert scale.shape == (16,) and scale.dtype == jnp.float32
+    back = np.asarray(quant.dequant_leaf(q, scale))
+    # round-to-nearest: each element is within half a quantization step
+    # of its channel's scale
+    err = np.abs(back - np.asarray(w))
+    assert np.all(err <= np.asarray(scale) / 2 + 1e-7)
+    # symmetric: the amax element of every channel is exactly +-127
+    assert np.all(np.max(np.abs(np.asarray(q)), axis=(0, 1, 2)) == 127)
+
+
+def test_per_channel_beats_per_tensor_and_zero_channel_is_exact():
+    """The reason for per-output-channel scales: one big channel must
+    not wash out a small one. Column 0 is all-zero (scale 1.0, exact
+    reconstruction); column 1 is 1000x smaller than column 2 and would
+    quantize to pure noise under one per-tensor scale."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(64, 4).astype(np.float32)
+    w[:, 0] = 0.0
+    w[:, 1] *= 1e-3
+    w[:, 2] *= 1.0
+    w[:, 3] *= 10.0
+    q, scale = quant.quantize_leaf(jnp.asarray(w))
+    assert float(scale[0]) == 1.0
+    back = np.asarray(quant.dequant_leaf(q, scale))
+    np.testing.assert_array_equal(back[:, 0], 0.0)
+    # per-tensor twin: one scale from the global amax
+    g = np.abs(w).max() / quant.QMAX
+    per_tensor = np.clip(np.round(w / g), -quant.QMAX, quant.QMAX) * g
+    pc_err = np.abs(back[:, 1] - w[:, 1]).max()
+    pt_err = np.abs(per_tensor[:, 1] - w[:, 1]).max()
+    assert pc_err < 0.01 * pt_err, (pc_err, pt_err)
+
+
+def test_quantize_rule_skips_non_kernels():
+    variables = _mlp_variables()
+    qvars = quant.quantize_variables(variables)
+    kernels = [l for p, l in jax.tree_util.tree_flatten_with_path(
+        qvars["params"])[0] if quant._is_weight(p, l)]
+    assert kernels and all(l.dtype == jnp.int8 for l in kernels)
+    others = [l for p, l in jax.tree_util.tree_flatten_with_path(
+        qvars["params"])[0] if not quant._is_weight(p, l)]
+    assert all(l.dtype != jnp.int8 for l in others)
+    assert len(qvars[quant.QSCALES_KEY]) == len(kernels)
+    # batch_stats ride along untouched
+    jax.tree_util.tree_map(np.testing.assert_array_equal,
+                           qvars["batch_stats"],
+                           variables["batch_stats"])
+
+
+# --------------------------------------------------------- config guards
+def test_check_quantize_config_guards():
+    cfg = _mlp_cfg()
+    quant.check_quantize_config(cfg, data_axis=8)  # off: always fine
+    cfg.serve.quantize = "int4"
+    with pytest.raises(ValueError, match="serve.quantize must be one of"):
+        quant.check_quantize_config(cfg)
+    cfg.serve.quantize = "int8"
+    cfg.model.sync_bn = False
+    quant.check_quantize_config(cfg, data_axis=1)  # single replica: fine
+    with pytest.raises(ValueError, match="requires model.sync_bn"):
+        quant.check_quantize_config(cfg, data_axis=2)
+
+
+# ----------------------------------------------------------- calibration
+def test_calibration_deterministic_and_digest_verified(tmp_path):
+    cfg = _mlp_cfg(**{"serve.calibration_batches": 2,
+                      "serve.calibration_batch": 16})
+    rec1 = calibrate.collect_ranges(cfg)
+    rec2 = calibrate.collect_ranges(cfg)
+    assert rec1 == rec2
+    assert rec1["digest"] == calibrate.calibration_digest(rec1)
+    assert rec1["act_max"]["input"] > 0
+    p1 = calibrate.write_calibration(rec1, str(tmp_path / "a"))
+    p2 = calibrate.write_calibration(rec2, str(tmp_path / "b"))
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        assert f1.read() == f2.read()
+
+    # ensure_calibration reuses the matching file (no second pass)
+    assert calibrate.ensure_calibration(cfg, str(tmp_path / "a")) == rec1
+
+    # a tampered record must never silently scale a fleet
+    with open(p1) as f:
+        tampered = json.load(f)
+    tampered["act_max"]["input"] += 1.0
+    with open(p1, "w") as f:
+        json.dump(tampered, f)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        calibrate.load_calibration(str(tmp_path / "a"))
+
+
+# -------------------------------------------------------------- registry
+def test_q8_key_family_parity():
+    from tpu_resnet.analysis.configmatrix import MATRIX
+
+    rows = {e.name: e for e in MATRIX}
+    assert programs.spell_entry(rows["serve_synthetic_mlp_f32_b4_q8"]) \
+        == "serve|synthetic_mlp_f32_q8|mesh1x1|b4"
+    assert programs.spell_entry(rows["serve_synthetic_mlp_f32_b4"]) \
+        == "serve|synthetic_mlp_f32|mesh1x1|b4"
+    assert programs.spell_entry(rows["serve_cifar10_rn8_f32_b8_q8"]) \
+        == "serve|cifar10_rn8_f32_q8|mesh1x1|b8"
+
+    # the suffix is serve-only: a train key never quantizes
+    cfg = _mlp_cfg(**{"serve.quantize": "int8"})
+    assert programs.spell(cfg, {"data": 1}, kind="serve", batch=4) \
+        == "serve|synthetic_mlp_f32_q8|mesh1x1|b4"
+    assert "_q8" not in programs.spell(cfg, {"data": 1}, kind="train")
+    cfg.serve.quantize = "off"
+    assert "_q8" not in programs.spell(cfg, {"data": 1}, kind="serve",
+                                       batch=4)
+
+
+# ----------------------------------------------------------------- cache
+def test_quantized_executable_cache_round_trip(tmp_path):
+    """The serve warmup path for a quantized bucket: AOT-compile over
+    the int8 argument avals, restart the process, reload from cache and
+    get value-identical logits (tests/test_programs.py idiom)."""
+    from tpu_resnet.programs import registry as registry_mod
+    from tpu_resnet.programs.registry import ProgramRegistry
+
+    cfg = _mlp_cfg(**{"serve.quantize": "int8"})
+    cfg.programs.cache = "on"
+    cfg.programs.cache_dir = str(tmp_path / "progcache")
+
+    variables = _mlp_variables()
+    qvars = quant.quantize_variables(variables, act_max=4.0)
+    qsds = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), qvars)
+    imgs = jax.ShapeDtypeStruct((4, 32, 32, 3), jnp.uint8)
+    key = programs.spell(cfg, {"data": 1}, kind="serve", batch=4)
+    images, _ = synthetic_data(4, 32, 10, seed=3)
+
+    reg = ProgramRegistry(cfg)
+    program, hit = reg.wrap(key, make_serve_infer(cfg), (qsds, imgs))
+    assert not hit
+    out_cold = np.asarray(program(qvars, jnp.asarray(images)))
+    assert any(f.endswith(".aotx")
+               for f in os.listdir(cfg.programs.cache_dir))
+
+    registry_mod._loaded_once.clear()  # simulate a process restart
+    reg2 = ProgramRegistry(cfg)
+    program2, hit2 = reg2.wrap(key, make_serve_infer(cfg), (qsds, imgs))
+    assert hit2 and reg2.hits == 1
+    np.testing.assert_array_equal(
+        out_cold, np.asarray(program2(qvars, jnp.asarray(images))))
+
+
+# ---------------------------------------------------------------- parity
+def test_live_argmax_parity_gate():
+    """THE accuracy gate: quantized serve inference must agree with the
+    f32 twin on >= 99% of argmax decisions and hold top-1 within 0.5pt."""
+    variables = _mlp_variables()
+    images, labels = synthetic_data(64, 32, 10, seed=5)
+    f32_cfg = _mlp_cfg()
+    qcfg = _mlp_cfg(**{"serve.quantize": "int8"})
+    act_max = _calibrated_act_max(f32_cfg, images)
+
+    f32_logits = np.asarray(make_serve_infer(f32_cfg)(
+        variables, jnp.asarray(images)))
+    qvars = quant.quantize_variables(variables, act_max=act_max)
+    q_logits = np.asarray(make_serve_infer(qcfg)(
+        qvars, jnp.asarray(images)))
+
+    f32_top1 = np.argmax(f32_logits, axis=1)
+    q_top1 = np.argmax(q_logits, axis=1)
+    parity = float(np.mean(q_top1 == f32_top1))
+    assert parity >= 0.99, parity
+    acc_delta = abs(float(np.mean(q_top1 == labels))
+                    - float(np.mean(f32_top1 == labels)))
+    assert acc_delta <= 0.005, acc_delta
+
+
+def test_quantized_export_bundle_parity_and_footprint(tmp_path):
+    """The export-side twin of the live gate: a quantized StableHLO
+    bundle must carry the int8 weights as ``weights.npz`` arguments
+    (NOT constant-folded fp32 — the manifest's ``weight_bytes`` proves
+    it), stamp quant provenance, and hold the same parity gates against
+    the f32 bundle.
+
+    Calibration here is the gate batch itself: with untrained random
+    weights the logit top-2 gaps are near-ties, so an act scale from a
+    DIFFERENT batch can flip a handful of argmaxes — a trained
+    checkpoint has real margins (the quant_ab_probe drill and the v5e
+    campaign cover that side); this test pins the export mechanism."""
+    from tpu_resnet.export import load_inference, save_inference
+
+    variables = _mlp_variables()
+    images, labels = synthetic_data(64, 32, 10, seed=5)
+
+    f32_dir = str(tmp_path / "f32")
+    save_inference(_mlp_cfg(), variables["params"],
+                   variables["batch_stats"], f32_dir, batch_size=64)
+    q_dir = str(tmp_path / "q8")
+    qcfg = _mlp_cfg(**{"serve.quantize": "int8"})
+    calibration = {"format": calibrate.FORMAT,
+                   "dataset": qcfg.data.dataset,
+                   "image_size": qcfg.data.resolved_image_size,
+                   "batches": 1, "batch": 64,
+                   "act_max": {"input": _calibrated_act_max(qcfg, images)}}
+    calibration["digest"] = calibrate.calibration_digest(calibration)
+    save_inference(qcfg, variables["params"], variables["batch_stats"],
+                   q_dir, batch_size=64, calibration=calibration)
+
+    q_bundle = load_inference(q_dir)
+    man = q_bundle.manifest
+    assert man["quantize"] == "int8"
+    assert man["calibration_digest"] == calibration["digest"]
+    assert os.path.exists(os.path.join(q_dir, man["weights"]))
+    with open(os.path.join(f32_dir, "manifest.json")) as f:
+        f32_man = json.load(f)
+    assert man["weight_bytes"] <= 0.30 * f32_man["weight_bytes"]
+
+    f32_top1 = np.argmax(load_inference(f32_dir)(images), axis=1)
+    q_top1 = np.argmax(q_bundle(images), axis=1)
+    assert float(np.mean(q_top1 == f32_top1)) >= 0.99
+    assert abs(float(np.mean(q_top1 == labels))
+               - float(np.mean(f32_top1 == labels))) <= 0.005
+
+
+# ----------------------------------------------------------- golden twins
+def test_golden_memory_quant_twin_gate():
+    """THE memory acceptance artifact: analysis/golden_memory.json must
+    carry the serve f32/q8 twins with the quantized row's
+    weight-argument bytes <= 0.30x of the f32 twin (int8 kernels + fp32
+    per-channel scales ~= 0.25x + slack) — and the whole argument
+    footprint smaller too."""
+    with open(os.path.join(ANALYSIS_DIR, "golden_memory.json")) as f:
+        entries = json.load(f)["entries"]
+    for f32_name in ("serve_cifar10_rn8_f32_b8",
+                     "serve_synthetic_mlp_f32_b4"):
+        f32 = entries[f32_name]
+        q8 = entries[f32_name + "_q8"]
+        assert q8["weight_argument_bytes"] > 0
+        assert q8["weight_argument_bytes"] \
+            <= 0.30 * f32["weight_argument_bytes"], (f32_name, q8, f32)
+        assert q8["argument_bytes"] < f32["argument_bytes"]
+
+
+def test_golden_jaxprs_carry_quant_serve_rows():
+    with open(os.path.join(ANALYSIS_DIR, "golden_jaxprs.json")) as f:
+        entries = json.load(f)["entries"]
+    for name in ("serve_cifar10_rn8_f32_b8", "serve_cifar10_rn8_f32_b8_q8",
+                 "serve_synthetic_mlp_f32_b4",
+                 "serve_synthetic_mlp_f32_b4_q8"):
+        assert name in entries, name
+    # twins are DIFFERENT programs: the digests must not collide
+    assert entries["serve_synthetic_mlp_f32_b4_q8"] \
+        != entries["serve_synthetic_mlp_f32_b4"]

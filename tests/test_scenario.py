@@ -334,7 +334,8 @@ def test_catalog_lists_every_checked_in_drill_with_tier():
     for name in ("fault_drill", "serve_probe", "trace_probe",
                  "mem_probe", "partition_probe", "reshape_drill",
                  "sweep_probe", "corrupt_ckpt_while_polling",
-                 "preempt_burst_under_fleet", "reshape_during_burst"):
+                 "preempt_burst_under_fleet", "reshape_during_burst",
+                 "quant_ab_probe"):
         assert name in entries, name
         assert entries[name]["tier"] in ("fast", "slow")
         assert os.path.exists(entries[name]["path"])

@@ -58,3 +58,30 @@ def test_preempt_burst_under_fleet_composed_drill(tmp_path):
     steps = _steps_by_label(result)
     assert steps["traffic"]["ok"]
     assert all(s["ok"] for s in result["steps"])
+
+
+def test_quant_ab_probe_composed_drill(tmp_path):
+    """The int8 quantization A/B drill: a bf16 and an int8-quantized
+    replica of the same checkpoint serve behind the router with zero
+    hard failures, loadgen --ab pairs both arms in one result with
+    self-reported arm labels, and the per-arm throughput/p99/weight-byte
+    series land in perfwatch under the sweep-scn: prefix (the _bytes
+    memory series is judged lower-is-better there)."""
+    result = conduct_file(scenario_path("quant_ab_probe"),
+                          run_dir=str(tmp_path / "run"))
+    assert result["ok"], result
+    assert set(result["rcs"].values()) == {0}, result["rcs"]
+    steps = _steps_by_label(result)
+    assert steps["router_traffic"]["ok"] and steps["ab_traffic"]["ok"]
+    # arm identity came from each replica's own /info, not config
+    assert steps["q8_info"]["observed"]["quantize"] == "int8"
+    assert steps["q8_info"]["observed"]["calibration_digest"]
+    # the quantized arm's weight-argument bytes beat the 0.30x twin gate
+    q8 = steps["q8_info"]["observed"]["weight_bytes"]
+    f32 = steps["bf16_info"]["observed"]["weight_bytes"]
+    assert 0 < q8 <= 0.30 * f32, (q8, f32)
+    # every declared series (incl. both _bytes memory series) ingested
+    pw = result["perfwatch"]
+    assert pw["ran"] and pw["rc"] == 0
+    assert all(pw["ingested"].values()), pw["ingested"]
+    assert "sweep-scn:quant_ab_probe:int8_weight_bytes" in pw["ingested"]

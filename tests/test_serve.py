@@ -950,6 +950,7 @@ def test_checkpoint_backend_close_blocks_until_swap_completes(
     b._closed = False
     b._variables = None
     b.model_step = -1
+    b.quantize = "off"
 
     results = []
     loader = threading.Thread(target=lambda: results.append(b._load(5)))

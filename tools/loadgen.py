@@ -48,6 +48,16 @@ Client-side failure classes are DISTINCT in the result: ``failed``
 / ``--timeout``), ``connect_failures`` (refused/reset). A refused
 connection and a slow reply are different fleet bugs.
 
+A/B mode (``--ab URL_B`` or ``--ab-name NAME``): drive TWO endpoints
+with the IDENTICAL paired load — same clients, pacing, request bodies
+and seed, run sequentially so the arms never contend for client CPU —
+and emit ONE RESULT_JSON with both tallies under ``arms.a``/``arms.b``
+plus a ``delta`` block of B-over-A ratios. Arm labels come from each
+endpoint's own ``/info`` (``quantize`` when not "off", else
+``compute_dtype``), so a quantized-vs-bf16 comparison labels itself
+with no out-of-band config — the int8 serve arm's gate rides this
+(scenarios/quant_ab_probe.json).
+
 Usage:
     python tools/loadgen.py --url http://127.0.0.1:PORT [--clients 8]
         [--duration 10] [--mode closed|open] [--qps 100]
@@ -487,6 +497,76 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
     return result
 
 
+# ------------------------------------------------------------- A/B mode
+AB_SCENARIOS = ("steady", "burst", "ramp", "mixed_lane")
+
+
+def _arm_label(url: str, fallback: str) -> str:
+    """Self-reported arm label from the endpoint's /info: the quant mode
+    when quantized, else the compute dtype — no out-of-band config."""
+    try:
+        info = _get_json(url.rstrip("/") + "/info")
+    except (OSError, ValueError):
+        return fallback
+    q = info.get("quantize", "off")
+    if q and q != "off":
+        return str(q)
+    return str(info.get("compute_dtype") or fallback)
+
+
+def run_ab(url_a: str, url_b: str, **kw) -> dict:
+    """Paired A/B: run_load twice with identical kwargs (same seed →
+    byte-identical request bodies and pacing), sequentially, and merge
+    into one result. Top-level failure counters are the SUM of both
+    arms, so the exit-code contract and the scenario conductor's
+    ``loadgen_result`` checker gate both arms at once."""
+    if kw.get("scenario", "steady") not in AB_SCENARIOS:
+        raise ValueError(f"--ab supports scenarios {AB_SCENARIOS}; the "
+                         f"chaos scenarios mutate the fleet and would "
+                         f"not give arm B the same world as arm A")
+    label_a = _arm_label(url_a, "a")
+    label_b = _arm_label(url_b, "b")
+    if label_a == label_b:
+        label_a, label_b = label_a + "_a", label_b + "_b"
+    res_a = run_load(url_a, **kw)
+    res_b = run_load(url_b, **kw)
+    scenario = res_a["scenario"]
+    totals = {k: res_a[k] + res_b[k]
+              for k in ("requests_ok", "rejected_429", "failed",
+                        "timeouts", "connect_failures")}
+    ta, tb = res_a["throughput_rps"], res_b["throughput_rps"]
+    pa = res_a["latency_ms"]["p99"]
+    pb = res_b["latency_ms"]["p99"]
+    hard = (totals["failed"] + totals["timeouts"]
+            + totals["connect_failures"])
+    return {
+        "ab": True,
+        "scenario": scenario,
+        "mode": res_a["mode"], "clients": res_a["clients"],
+        "seed": kw.get("seed", 0),
+        "arms": {"a": dict(res_a, arm=label_a, url=url_a),
+                 "b": dict(res_b, arm=label_b, url=url_b)},
+        **totals,
+        # B-over-A ratios: >1 throughput / <1 p99 means arm B wins.
+        "delta": {
+            "throughput_rps_b_over_a":
+                round(tb / ta, 4) if ta else None,
+            "p99_ms_b_over_a": round(pb / pa, 4) if pa else None,
+        },
+        # One paired point per arm: perfwatch cohorts each arm's
+        # trajectory separately under the same scenario id.
+        "points": [
+            {"id": f"scenario={scenario}:arm={label_a}",
+             "status": "ok" if hard == 0 and res_a["requests_ok"] > 0
+             else "error", "backend": "serve", "steps_per_sec": ta},
+            {"id": f"scenario={scenario}:arm={label_b}",
+             "status": "ok" if hard == 0 and res_b["requests_ok"] > 0
+             else "error", "backend": "serve", "steps_per_sec": tb},
+        ],
+        "backend": "serve",
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--url", default="",
@@ -494,6 +574,16 @@ def main(argv=None) -> int:
     ap.add_argument("--train-dir", default="",
                     help="discover the port from <train-dir>/route.json "
                          "(router, preferred) or serve.json")
+    ap.add_argument("--name", default="",
+                    help="drive a NAMED replica instead: discover its "
+                         "port from <train-dir>/serve-<name>.json")
+    ap.add_argument("--ab", default="", metavar="URL_B",
+                    help="A/B mode: also drive this endpoint with the "
+                         "identical paired load; one RESULT_JSON with "
+                         "arms.a/arms.b and B-over-A deltas")
+    ap.add_argument("--ab-name", default="",
+                    help="A/B mode with discovery: arm B is the named "
+                         "replica's serve-<name>.json under --train-dir")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--mode", choices=["closed", "open"], default="closed")
@@ -529,34 +619,54 @@ def main(argv=None) -> int:
                          "(atomic tmp+rename)")
     args = ap.parse_args(argv)
 
+    def named_port(name: str):
+        from tpu_resnet.serve.discovery import read_port
+        return read_port(args.train_dir, f"serve-{name}.json")
+
     url = args.url
     fleet_dir = args.fleet_dir or args.train_dir
     if not url:
         if not args.train_dir:
             ap.error("need --url or --train-dir")
-        from tpu_resnet.serve.router import read_route_port
-        from tpu_resnet.serve.server import read_serve_port
-        port = read_route_port(args.train_dir)
+        if args.name:
+            port = named_port(args.name)
+        else:
+            from tpu_resnet.serve.router import read_route_port
+            from tpu_resnet.serve.server import read_serve_port
+            port = read_route_port(args.train_dir)
+            if port is None:
+                port = read_serve_port(args.train_dir)
         if port is None:
-            port = read_serve_port(args.train_dir)
-        if port is None:
-            print(f"[loadgen] no route.json/serve.json under "
-                  f"{args.train_dir}", file=sys.stderr)
+            print(f"[loadgen] no discovery file under "
+                  f"{args.train_dir}"
+                  + (f" for replica {args.name!r}" if args.name else ""),
+                  file=sys.stderr)
             return 2
         url = f"http://127.0.0.1:{port}"
 
+    ab_url = args.ab
+    if args.ab_name:
+        if not args.train_dir:
+            ap.error("--ab-name needs --train-dir for discovery")
+        port_b = named_port(args.ab_name)
+        if port_b is None:
+            print(f"[loadgen] no serve-{args.ab_name}.json under "
+                  f"{args.train_dir}", file=sys.stderr)
+            return 2
+        ab_url = f"http://127.0.0.1:{port_b}"
+
+    kw = dict(clients=args.clients, duration=args.duration,
+              mode=args.mode, qps=args.qps, scenario=args.scenario,
+              deadline_ms=args.deadline_ms, fleet_dir=fleet_dir,
+              router_url=args.router_url,
+              drain_interval=args.drain_interval,
+              slow_clients=args.slow_clients,
+              images_per_request=args.images_per_request,
+              image_size=args.image_size, timeout=args.timeout,
+              seed=args.seed)
     try:
-        result = run_load(url, clients=args.clients,
-                          duration=args.duration, mode=args.mode,
-                          qps=args.qps, scenario=args.scenario,
-                          deadline_ms=args.deadline_ms,
-                          fleet_dir=fleet_dir,
-                          router_url=args.router_url,
-                          drain_interval=args.drain_interval,
-                          slow_clients=args.slow_clients,
-                          images_per_request=args.images_per_request,
-                          image_size=args.image_size,
-                          timeout=args.timeout, seed=args.seed)
+        result = run_ab(url, ab_url, **kw) if ab_url \
+            else run_load(url, **kw)
     except (OSError, ValueError) as e:
         print(f"[loadgen] cannot drive {url}: {type(e).__name__}: {e}",
               file=sys.stderr)

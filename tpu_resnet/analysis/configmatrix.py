@@ -114,8 +114,14 @@ class MatrixEntry:
     # ADVICE r4 bypass path); "staged-chunk" traces the fused multi-step
     # chunk program over a staged superbatch (device_data.make_chunk_fn
     # — the program the double-buffered H2D path dispatches) instead of
-    # the single step.
+    # the single step; "serve" traces the bucket inference program
+    # (serve/infer.make_serve_infer — what the CheckpointBackend warms
+    # per bucket), with ``batch`` as the bucket size.
     builder: str = "config"
+    # serve builder only: serve.quantize (off | int8). int8 rows trace
+    # the quantized program over the int8 argument tree of ops/quant.py
+    # and spell under the registry's `_q8` key family.
+    quantize: str = "off"
     # staged-chunk only: steps fused per dispatch / superbatch stage rows.
     chunk_steps: int = 4
     stage_rows: int = 8
@@ -147,6 +153,7 @@ class MatrixEntry:
         cfg.mesh.model = self.model_axis
         cfg.mesh.partition = self.partition
         cfg.train.global_batch_size = self.batch
+        cfg.serve.quantize = self.quantize
         return cfg
 
 
@@ -244,6 +251,20 @@ MATRIX: Tuple[MatrixEntry, ...] = (
     _e("cifar10_rn8_f32_staged_chunk", builder="staged-chunk"),
     _e("imagenet_rn18_bf16_staged_chunk", dataset="imagenet", size=18,
        dtype="bfloat16", builder="staged-chunk"),
+    # --- int8 post-training-quantized serve arm (ops/quant.py,
+    # serve/infer.py; docs/SERVING.md "Quantized arm"): each quantized
+    # bucket program is golden-pinned NEXT TO its f32 twin — same model,
+    # same bucket, weights as int8 arguments + folded dequant — and the
+    # memory ledger's twin gate (analysis/memorybudget.py,
+    # tests/test_quant.py) holds the quantized row's weight-argument
+    # bytes to <= 0.30x of the twin's, the ZeRO-1 0.125x pattern.
+    _e("serve_cifar10_rn8_f32_b8", builder="serve", batch=8),
+    _e("serve_cifar10_rn8_f32_b8_q8", builder="serve", batch=8,
+       quantize="int8"),
+    _e("serve_synthetic_mlp_f32_b4", builder="serve", dataset="synthetic",
+       model="mlp", batch=4),
+    _e("serve_synthetic_mlp_f32_b4_q8", builder="serve",
+       dataset="synthetic", model="mlp", batch=4, quantize="int8"),
     # --- guard contracts: unsupported combinations must raise ---------
     _e("raise_fused_wrn", dataset="cifar100", size=28, width=10,
        fused=True,
@@ -260,6 +281,15 @@ MATRIX: Tuple[MatrixEntry, ...] = (
        expect_error="zero1 on a multi-chip data axis requires.*sync_bn"),
     _e("raise_bad_partition_mode", partition="zero2",
        expect_error="mesh.partition must be one of"),
+    # int8 serving of a per-replica-BN multi-replica config: each
+    # replica's folded BN affine differs, so one calibration cannot be
+    # parity-gated — must refuse (ops/quant.py check_quantize_config).
+    _e("raise_quant_perreplica", builder="serve", quantize="int8",
+       data_axis=8, sync_bn=False,
+       expect_error="serve.quantize=int8 requires model.sync_bn"),
+    # Unknown quant mode strings fail loudly, like fused_epilogue typos.
+    _e("raise_bad_quantize_mode", builder="serve", quantize="int4",
+       expect_error="serve.quantize must be one of"),
 )
 
 
@@ -306,6 +336,9 @@ def _abstract_programs(entry: MatrixEntry):
         cifar_resnet_v2(entry.size, 10, fused_blocks=True,
                         bn_axis_name="data")
         raise AssertionError("constructor guard did not fire")
+
+    if entry.builder == "serve":
+        return _abstract_serve_program(entry)
 
     cfg = entry.to_config()
     check_step_config(cfg, entry.data_axis)  # the loop's own gate
@@ -374,6 +407,46 @@ def _abstract_programs(entry: MatrixEntry):
         state_sds, imgs, labels)))
     return train_text, eval_text, _state_layout(state_sds), \
         (state_sds, out_shapes)
+
+
+def _abstract_serve_program(entry: MatrixEntry):
+    """Trace the bucket inference program for a serve row — the exact
+    ``make_serve_infer`` jit the CheckpointBackend warms per bucket,
+    over the exact argument avals it wraps (the int8 quantized tree for
+    ``quantize="int8"`` rows — ops/quant.py). Returned in the train-row
+    shape (variables stand in for state; empty metrics) so the
+    structural checks — forbidden dtypes, layout identity — apply
+    unchanged; int8 is deliberately NOT a forbidden dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet.models import build_model
+    from tpu_resnet.ops import quant as quant_lib
+    from tpu_resnet.serve.infer import make_serve_infer
+
+    cfg = entry.to_config()
+    # The serve arm's own config gate — must-raise quant rows fire here.
+    quant_lib.check_quantize_config(cfg, entry.data_axis)
+    model = build_model(cfg)  # constructor guards run here
+    size = cfg.data.resolved_image_size
+    sample = jnp.zeros((1, size, size, 3), jnp.float32)
+
+    def init_vars(rng):
+        v = model.init(rng, sample, train=False)
+        return {"params": v["params"],
+                "batch_stats": v.get("batch_stats", {})}
+
+    var_sds = jax.eval_shape(init_vars, jax.random.PRNGKey(0))
+    if cfg.serve.quantize == "int8":
+        var_sds = jax.eval_shape(quant_lib.quantize_variables, var_sds)
+    infer = make_serve_infer(cfg)
+    imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
+    infer_text = canonicalize(str(jax.make_jaxpr(infer)(var_sds, imgs)))
+    # No eval twin and no metrics on the serve path: the empty eval text
+    # hashes to a constant and the (vars, (vars, {})) shape tuple makes
+    # the layout-identity check trivially true.
+    return infer_text, "", _state_layout(var_sds), \
+        (var_sds, (var_sds, {}))
 
 
 def _structural_findings(entry: MatrixEntry, train_text: str,

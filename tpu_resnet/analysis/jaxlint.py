@@ -88,7 +88,12 @@ JIT_SCOPE_FILES = ("tpu_resnet/train/step.py",
                    # the zero1 weight update and the constraint helpers
                    # it calls trace INSIDE the step program
                    "tpu_resnet/parallel/zero.py",
-                   "tpu_resnet/parallel/partition.py")
+                   "tpu_resnet/parallel/partition.py",
+                   # int8 quant/dequant math traces inside the serving
+                   # program (the dequant fold in make_serve_infer) —
+                   # already under the ops/ prefix, listed explicitly
+                   # because it is a named serve-hot-path contract
+                   "tpu_resnet/ops/quant.py")
 JIT_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 
 # Module-scope import closure of the spawn'd decode worker

@@ -52,11 +52,55 @@ DEFAULT_TOLERANCE = 0.10
 SLACK_BYTES = 65536
 
 
+def _compile_serve_budget(entry: MatrixEntry) -> dict:
+    """Serve rows compile the bucket inference program instead — the
+    exact ``make_serve_infer`` jit the CheckpointBackend warms, over the
+    exact argument avals it wraps (the int8 quantized tree for
+    ``quantize="int8"`` rows). The analytic headline here is
+    ``weight_argument_bytes`` — the weight-side argument footprint
+    (ops/quant.py tree arithmetic, exact compare) — which is what the
+    quantized/f32 twin gate in tests/test_quant.py reads: the int8 arm
+    must land at ≤0.30x of its f32 twin, the memory acceptance artifact
+    of the quantization PR (same pattern as the ZeRO-1 opt-slot twin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resnet.models import build_model
+    from tpu_resnet.ops import quant as quant_lib
+    from tpu_resnet.serve.infer import make_serve_infer
+
+    cfg = entry.to_config()
+    quant_lib.check_quantize_config(cfg, entry.data_axis)
+    model = build_model(cfg)
+    size = cfg.data.resolved_image_size
+    sample = jnp.zeros((1, size, size, 3), jnp.float32)
+
+    def init_vars(rng):
+        v = model.init(rng, sample, train=False)
+        return {"params": v["params"],
+                "batch_stats": v.get("batch_stats", {})}
+
+    var_sds = jax.eval_shape(init_vars, jax.random.PRNGKey(0))
+    if cfg.serve.quantize == "int8":
+        var_sds = jax.eval_shape(quant_lib.quantize_variables, var_sds)
+    imgs = jax.ShapeDtypeStruct((entry.batch, size, size, 3), jnp.uint8)
+    compiled = make_serve_infer(cfg).lower(var_sds, imgs).compile()
+    budget = budget_from_compiled(compiled)
+    if budget is None:
+        raise RuntimeError("backend reported no memory analysis for the "
+                           "compiled program")
+    budget["partition"] = entry.partition
+    budget["weight_argument_bytes"] = quant_lib.tree_argument_bytes(var_sds)
+    return budget
+
+
 def compile_entry_budget(entry: MatrixEntry) -> dict:
     """Compile the entry's REAL train program on a concrete mesh (the
     loop's own constructors, donation on) and return its memory budget.
     Needs ``data_axis * model_axis`` local devices — the caller skips
-    otherwise."""
+    otherwise. Serve rows dispatch to ``_compile_serve_budget``."""
+    if getattr(entry, "builder", "config") == "serve":
+        return _compile_serve_budget(entry)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -133,7 +177,11 @@ def compile_entry_budget(entry: MatrixEntry) -> dict:
 # it compares EXACTLY (no band): a partial rule regression that shifts
 # XLA's aggregate by less than the slack still moves these.
 ANALYTIC_COMPONENTS = ("params_argument_bytes", "opt_state_argument_bytes",
-                       "batch_stats_argument_bytes")
+                       "batch_stats_argument_bytes",
+                       # Serve rows only (0 == 0 elsewhere): the weight-
+                       # argument footprint of the bucket program — the
+                       # int8/f32 twin-gate numerator/denominator.
+                       "weight_argument_bytes")
 
 
 def _compare(name: str, want: dict, got: dict,

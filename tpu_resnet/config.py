@@ -467,6 +467,20 @@ class ServeConfig:
     # each announce their own port/pid and the router (serve/router.py)
     # discovers the whole fleet from one directory scan.
     replica_name: str = ""
+    # Post-training quantization arm (ops/quant.py, serve/calibrate.py;
+    # docs/SERVING.md "Quantized arm"). "int8": symmetric per-output-
+    # channel int8 weight quantization + a calibrated per-tensor input
+    # scale; the quantized tree is the PROGRAM ARGUMENT of a separate
+    # registry program family (`_q8` key suffix), so buckets, AOT cache
+    # entries, memory ledgers and golden twins all see it as its own
+    # canonical program. Parity is gated (argmax >= 99% vs the f32/bf16
+    # twin on the calibration set; tests/test_quant.py).
+    quantize: str = "off"  # off | int8
+    # Calibration (int8 only): N deterministic eval-split batches of
+    # this size feed range collection; the result is digest-stamped into
+    # <train_dir>/calibration.json and reused when present.
+    calibration_batches: int = 4
+    calibration_batch: int = 64
 
 
 @dataclasses.dataclass

@@ -143,6 +143,11 @@ SERVE_GAUGES = (
                                     "warmup wall time until /healthz ok"),
     ("serve_buckets_warm", "Bucket programs warmed so far (== bucket "
                            "count once ready; partial during warmup)"),
+    # Quantized-arm memory (ops/quant.py; docs/SERVING.md "Quantized
+    # arm"): weight-argument bytes of one bucket program — int8 arms
+    # read ~0.25x their f32 twin (the golden-memory-twin ratio, live).
+    ("serve_weight_bytes", "Weight-argument bytes per bucket program "
+                           "(int8 quantized arms ~0.25x of f32)"),
     ("compile_cache_hits", "Bucket programs loaded from the persistent "
                            "AOT executable cache instead of compiling"),
     ("compile_cache_misses", "Bucket programs XLA-compiled because the "

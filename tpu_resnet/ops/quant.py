@@ -1,0 +1,186 @@
+"""Post-training int8 quantization math — symmetric per-output-channel
+weight quantization plus a per-tensor activation scale.
+
+The serving-cost lever: a ResNet's weight argument traffic is dominated
+by conv kernels, and an int8 kernel plus one fp32 scale vector per
+output channel is ~0.25x the bytes of the fp32 twin. The math here is
+the *argument-side* half of that story — the quantized serve programs
+(serve/backend.py, export/serialize.py) take int8 kernels as program
+arguments and dequantize inside the jitted program, so the AOT cache,
+memory ledger and golden-memory twins all see the smaller argument
+footprint as a property of the canonical program signature.
+
+Why symmetric, and why per-output-channel: a convolution is linear in
+its kernel, so a per-OUTPUT-channel dequant scale commutes through the
+conv to a per-channel multiply on the conv output — which is exactly
+the ``scale`` term of :func:`tpu_resnet.ops.epilogue.scale_bias_relu_math`
+(``relu(x * s + b)``). Symmetric quantization has no zero-point, so the
+fold contributes nothing to ``b``: dequant rides the epilogue multiply
+the BN fold already pays for, rather than adding a pass. (The explicit
+``dequant_leaf`` below is the XLA-visible spelling of that fold; XLA's
+fuser sinks the broadcast-multiply into the consumer, and the Pallas
+epilogue kernels would take it as part of ``s`` on TPU.)
+
+Activations use ONE per-tensor scale for the network input, calibrated
+over deterministic eval batches (serve/calibrate.py). Inputs are
+post-``eval_pre`` per-image-standardized, so their range is tight and
+data-independent enough for a single calibrated scale; fake-quantizing
+them (quantize→dequantize in fp32) bounds the activation error the
+parity gate (tests/test_quant.py) measures without committing the whole
+network to int8 activation arithmetic on hardware that may not win from
+it (the honest-CPU caveat in docs/PERF.md).
+
+Everything here is pure jnp — jit-scope clean (analysis/jaxlint.py
+lists this file) and safe to call inside traced serve programs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Allowed values for serve.quantize (config.py ServeConfig).
+QUANT_MODES = ("off", "int8")
+
+# int8 symmetric range: +-127 (the -128 code is unused so the range is
+# symmetric and scale * -q is always representable).
+QMAX = 127.0
+
+# Tree keys the quantized variables dict adds next to params/batch_stats.
+QSCALES_KEY = "qscales"
+QACT_KEY = "qact"
+
+
+def check_quantize_config(cfg, data_axis: int = 1) -> None:
+    """Config-time guards for the quantized serve arm (the
+    ``serve.quantize`` knob). Raises ValueError; configmatrix must-raise
+    rows pin both messages.
+
+    - Unknown mode strings fail loudly, like model.fused_epilogue typos.
+    - int8 + per-replica BN across a multi-replica data axis is refused:
+      per-replica batch statistics mean each replica folds a DIFFERENT
+      affine into the epilogue, so one calibrated weight/activation
+      scale set cannot be parity-gated against the f32 twin. SyncBN (or
+      a single replica) makes the folded affine well-defined.
+    """
+    mode = getattr(getattr(cfg, "serve", None), "quantize", "off")
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            "serve.quantize must be one of %s, got %r"
+            % ("|".join(QUANT_MODES), mode))
+    if mode == "int8" and data_axis > 1 and not cfg.model.sync_bn:
+        raise ValueError(
+            "serve.quantize=int8 requires model.sync_bn=true when "
+            "data_axis > 1: per-replica batch statistics give each "
+            "replica a different folded BN affine, so one calibration "
+            "cannot hold across the fleet")
+
+
+def _is_weight(path, leaf) -> bool:
+    """Quantization rule: conv/dense kernels only — leaves whose path
+    ends in ``kernel`` with ndim >= 2 (BN affines, biases and scalar
+    state stay fp32; they are epilogue-side anyway)."""
+    if not path or leaf.ndim < 2:
+        return False
+    last = path[-1]
+    name = getattr(last, "key", getattr(last, "name", last))
+    return name == "kernel"
+
+
+def quantize_leaf(w):
+    """Symmetric per-output-channel int8 quantization of one kernel.
+
+    The output channel is the LAST axis (flax HWIO conv kernels and
+    [in, out] Dense kernels both put it there). Returns ``(q, scale)``
+    with ``q`` int8 shaped like ``w`` and ``scale`` fp32 shaped
+    ``[C_out]``; all-zero channels get scale 1.0 so dequant is exact.
+    """
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    scale = jnp.where(amax > 0, amax / QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequant_leaf(q, scale, dtype=jnp.float32):
+    """Dequantize one kernel: ``q * scale`` broadcast over the output
+    channel — the multiply that commutes through the conv into the
+    scale_bias_relu epilogue (module docstring)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def act_scale_from_max(amax):
+    """Per-tensor activation scale from a calibrated max-abs value."""
+    amax = jnp.asarray(amax, jnp.float32)
+    return jnp.where(amax > 0, amax / QMAX, jnp.float32(1.0))
+
+
+def fake_quant(x, scale):
+    """Quantize→dequantize ``x`` with a per-tensor scale, in fp32 —
+    the activation-side error model the parity gate measures."""
+    scale = jnp.asarray(scale, jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -QMAX, QMAX)
+    return (q * scale).astype(x.dtype)
+
+
+def quantize_variables(variables, act_max=1.0):
+    """Quantize a serve variables dict ``{"params", "batch_stats"}``
+    into the quantized-program argument tree:
+
+    ``{"params": <kernels int8, rest unchanged>, "batch_stats": ...,
+    "qscales": {<keystr>: fp32 [C]}, "qact": {"input": fp32 scalar}}``
+
+    ``qscales`` is keyed by ``jax.tree_util.keystr`` of each quantized
+    leaf's path within params — flat, JSON-friendly, and stable across
+    restores. ``act_max`` is the calibrated input max-abs
+    (serve/calibrate.py).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        variables["params"])
+    qscales = {}
+    leaves = []
+    for path, leaf in flat:
+        if _is_weight(path, leaf):
+            q, scale = quantize_leaf(leaf)
+            qscales[jax.tree_util.keystr(path)] = scale
+            leaves.append(q)
+        else:
+            leaves.append(leaf)
+    return {
+        "params": jax.tree_util.tree_unflatten(treedef, leaves),
+        "batch_stats": variables["batch_stats"],
+        QSCALES_KEY: qscales,
+        QACT_KEY: {"input": act_scale_from_max(act_max)},
+    }
+
+
+def dequantize_variables(qvars, dtype=jnp.float32):
+    """Reconstruct the fp32 ``{"params", "batch_stats"}`` dict a flax
+    ``model.apply`` expects from the quantized argument tree. Traced
+    inside the serve program — this IS the folded dequant."""
+    qscales = qvars[QSCALES_KEY]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(qvars["params"])
+    leaves = []
+    for path, leaf in flat:
+        scale = qscales.get(jax.tree_util.keystr(path))
+        leaves.append(leaf if scale is None
+                      else dequant_leaf(leaf, scale, dtype))
+    return {
+        "params": jax.tree_util.tree_unflatten(treedef, leaves),
+        "batch_stats": qvars["batch_stats"],
+    }
+
+
+def tree_argument_bytes(tree) -> int:
+    """Total argument bytes of a (q)variables tree — works on arrays
+    and ShapeDtypeStructs alike. The memory ledger's
+    ``weight_argument_bytes`` analytic component and the
+    ``serve_weight_bytes`` gauge both come from here."""
+    return sum(_leaf_bytes(l) for l in jax.tree_util.tree_leaves(tree))
+
+
+def _leaf_bytes(leaf) -> int:
+    size = 1
+    for d in leaf.shape:
+        size *= int(d)
+    return size * jnp.dtype(leaf.dtype).itemsize

@@ -151,6 +151,8 @@ def spell(cfg, mesh_shape: Dict[str, int], kind: str = "train",
     partition = getattr(getattr(cfg, "mesh", None), "partition",
                         "replicated")
     per_replica = (not m.sync_bn) and data_axis > 1
+    quantized = (kind == "serve" and getattr(
+        getattr(cfg, "serve", None), "quantize", "off") == "int8")
     variant = (("_fused" if m.fused_blocks else "")
                + ("_remat" if m.remat else "")
                + ("_ep" if getattr(m, "fused_epilogue", "off") == "on"
@@ -158,7 +160,13 @@ def spell(cfg, mesh_shape: Dict[str, int], kind: str = "train",
                + ("_nos2d" if dataset.startswith("imagenet")
                   and not getattr(m, "stem_space_to_depth", True) else "")
                + ("_pr" if per_replica else "")
-               + (f"_{partition}" if partition != "replicated" else ""))
+               + (f"_{partition}" if partition != "replicated" else "")
+               # Quantized serve programs (serve.quantize=int8) take the
+               # int8 argument tree of ops/quant.py — a different
+               # signature AND different math, so a different key family
+               # (the _ep/_zero1 pattern). Serve-only: training is never
+               # quantized here.
+               + ("_q8" if quantized else ""))
     b = batch if batch is not None else cfg.train.global_batch_size
     return (f"{kind}|{dataset}_{name}_{dtype}{variant}"
             f"|mesh{data_axis}x{mesh_shape.get('model', 1)}|b{b}")
@@ -180,6 +188,13 @@ def spell_entry(entry) -> str:
                      {"data": entry.data_axis, "model": entry.model_axis},
                      kind="chunk", batch=entry.batch)
         return f"{base}|s{entry.stage_rows}c{entry.chunk_steps}"
+    if getattr(entry, "builder", "config") == "serve":
+        # Serve rows spell under kind "serve" — the exact bucket keys the
+        # CheckpointBackend's registry uses (quantized rows pick up the
+        # _q8 suffix from serve.quantize in to_config()).
+        return spell(entry.to_config(),
+                     {"data": entry.data_axis, "model": entry.model_axis},
+                     kind="serve", batch=entry.batch)
     return spell(entry.to_config(),
                  {"data": entry.data_axis, "model": entry.model_axis},
                  kind="train", batch=entry.batch)

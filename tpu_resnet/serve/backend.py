@@ -54,6 +54,18 @@ class ExportBackend:
         step = m.get("step")
         self.model_step = step if isinstance(step, int) else -1
         self.reloads = 0
+        # Quantization provenance travels IN the artifact: the manifest
+        # records the quant mode and the calibration digest it was built
+        # from, so /info can label this arm without out-of-band config.
+        self.quantize = m.get("quantize", "off")
+        self.calibration_digest = m.get("calibration_digest", "")
+        self._weight_bytes = int(m.get("weight_bytes", 0))
+
+    def weight_argument_bytes(self) -> int:
+        """Weight footprint as recorded at export time (weights are
+        baked into the frozen program, so the manifest is the source of
+        truth; pre-quant manifests report 0)."""
+        return self._weight_bytes
 
     def constrain_buckets(self, buckets: Sequence[int]) -> Tuple[int, ...]:
         """A fixed-batch artifact only accepts exactly-N calls: one
@@ -106,6 +118,26 @@ class CheckpointBackend:
         self.reloads = 0
         if mesh is None:
             mesh = parallel.create_mesh(cfg.mesh)
+        # Quantized arm (serve.quantize=int8; docs/SERVING.md): validate
+        # the combo up front (unknown modes and per-replica-BN meshes
+        # fail HERE, before any compile), then load-or-run calibration —
+        # the activation scale and its digest are fixed for the process
+        # lifetime, surviving hot-reloads (re-quantizing swapped weights
+        # reuses the same calibrated input scale; weight scales are
+        # recomputed from the new weights, which is what PTQ means).
+        from tpu_resnet.ops import quant as quant_lib
+
+        quant_lib.check_quantize_config(
+            cfg, data_axis=dict(mesh.shape).get("data", 1))
+        self.quantize = cfg.serve.quantize
+        self.calibration_digest = ""
+        self._act_max = 1.0
+        if self.quantize == "int8":
+            from tpu_resnet.serve import calibrate
+
+            record = calibrate.ensure_calibration(cfg, cfg.train.train_dir)
+            self._act_max = float(record["act_max"]["input"])
+            self.calibration_digest = record["digest"]
         # Program registry (tpu_resnet/programs): bucket programs are
         # built ahead-of-time through the persistent executable cache —
         # ON by default for serve (programs.cache=auto), because a
@@ -204,8 +236,17 @@ class CheckpointBackend:
             # dict — and the lock means close() can never tear the
             # manager down UNDER this restore (the drain-during-reload
             # contract: finish the swap or abort it cleanly).
-            self._variables = {"params": state.params,
-                               "batch_stats": state.batch_stats}
+            variables = {"params": state.params,
+                         "batch_stats": state.batch_stats}
+            if self.quantize == "int8":
+                # Quantize BEFORE the swap: the served reference is the
+                # int8 argument tree the _q8 bucket programs expect, so
+                # a hot-reload never mixes tree structures mid-batch.
+                from tpu_resnet.ops import quant as quant_lib
+
+                variables = quant_lib.quantize_variables(
+                    variables, act_max=self._act_max)
+            self._variables = variables
             self.model_step = int(step)
         self._poller.mark_seen(step)
         log.info("serve: loaded checkpoint step %d (%.2fs)", step,
@@ -214,6 +255,33 @@ class CheckpointBackend:
 
     def constrain_buckets(self, buckets: Sequence[int]) -> Tuple[int, ...]:
         return tuple(buckets)
+
+    def _var_avals(self):
+        """Abstract variables tree the bucket programs lower over —
+        the restore template's params/batch_stats avals, pushed through
+        an abstract quantization pass when serving int8 (eval_shape: no
+        device work), so warmup signatures match the concrete quantized
+        tree ``_load`` swaps in exactly."""
+        import jax
+
+        avals = {"params": self._template.params,
+                 "batch_stats": self._template.batch_stats}
+        if self.quantize == "int8":
+            from tpu_resnet.ops import quant as quant_lib
+
+            avals = jax.eval_shape(
+                lambda v: quant_lib.quantize_variables(
+                    v, act_max=self._act_max), avals)
+        return avals
+
+    def weight_argument_bytes(self) -> int:
+        """Per-bucket-program weight-argument footprint (batch-
+        independent) — the ``serve_weight_bytes`` gauge and the live
+        half of the golden-memory-twin story (analysis/memorybudget.py
+        pins the same number for the matrix entries)."""
+        from tpu_resnet.ops import quant as quant_lib
+
+        return quant_lib.tree_argument_bytes(self._var_avals())
 
     def bind_obs(self, telemetry=None, spans=None) -> None:
         """Late-bind the server's telemetry/span sinks onto the program
@@ -252,8 +320,7 @@ class CheckpointBackend:
         b = int(b)
         s = self.image_size
         if self._registry.cache_enabled and b not in self._compiled:
-            var_avals = {"params": self._template.params,
-                         "batch_stats": self._template.batch_stats}
+            var_avals = self._var_avals()
             img_aval = jax.ShapeDtypeStruct((b, s, s, 3), "uint8")
             program, hit = self._registry.wrap(
                 self._registry.key("serve", batch=b), self._infer_fn,

@@ -1,0 +1,123 @@
+"""Post-training quantization calibration — deterministic range
+collection for the int8 serve/export arm (ops/quant.py).
+
+Calibration here is deliberately small: symmetric weight quantization
+needs no data at all (scales come from the weights), so the only
+calibrated quantity is the per-tensor activation scale of the network
+INPUT — the max-abs of the eval-preprocessed image tensor over
+``serve.calibration_batches`` batches of ``serve.calibration_batch``
+images from the data engine's eval split. That split is iterated in
+deterministic order (data.eval_split_batches, stripe 0 of 1), so the
+same config + dataset seed produces a byte-identical
+``calibration.json`` — pinned by tests/test_quant.py and stamped with a
+content digest the export manifest and serve ``/info`` carry, so an A/B
+pair can prove both arms quantized from the same evidence.
+
+Host-side module: file I/O and eager numpy are fine here (this is NOT
+jit scope — the traced consumers live in ops/quant.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from tpu_resnet import data as data_lib
+from tpu_resnet.data import augment as aug_lib
+
+CALIBRATION_FILE = "calibration.json"
+FORMAT = "tpu_resnet.calibration.v1"
+
+
+def calibration_digest(record: dict) -> str:
+    """Content digest over every field except the digest itself —
+    canonical JSON so the stamp is stable across dict orderings."""
+    body = {k: v for k, v in sorted(record.items()) if k != "digest"}
+    blob = json.dumps(body, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def collect_ranges(cfg) -> dict:
+    """Run the calibration pass: eval-preprocess the first N deterministic
+    eval-split batches and record the observed activation range. Returns
+    the digest-stamped calibration record (not yet written)."""
+    batch = int(cfg.serve.calibration_batch)
+    batches = int(cfg.serve.calibration_batches)
+    _, eval_pre = aug_lib.get_augment_fns(cfg.data.dataset)
+    it = data_lib.eval_split_batches(cfg.data, batch,
+                                     process_index=0, process_count=1)
+    act_max = 0.0
+    seen = 0
+    try:
+        for images, labels in it:
+            real = labels >= 0  # padded tail rows are zeros; skip them
+            if np.any(real):
+                x = np.asarray(eval_pre(images[real]))
+                act_max = max(act_max, float(np.max(np.abs(x))))
+            seen += 1
+            if seen >= batches:
+                break
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()
+    record = {
+        "format": FORMAT,
+        "dataset": cfg.data.dataset,
+        "image_size": cfg.data.resolved_image_size,
+        "batches": seen,
+        "batch": batch,
+        "act_max": {"input": act_max},
+    }
+    record["digest"] = calibration_digest(record)
+    return record
+
+
+def write_calibration(record: dict, directory: str) -> str:
+    """Atomic write of ``<directory>/calibration.json``."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, CALIBRATION_FILE)
+    blob = json.dumps(record, indent=2, sort_keys=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(blob + "\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_calibration(directory: str) -> dict:
+    """Load + digest-verify a calibration record; raises ValueError on a
+    tampered or truncated file (a wrong digest must never silently scale
+    a fleet's quantized arm)."""
+    path = os.path.join(directory, CALIBRATION_FILE)
+    with open(path) as f:
+        record = json.load(f)
+    if record.get("digest") != calibration_digest(record):
+        raise ValueError(f"calibration digest mismatch in {path}")
+    return record
+
+
+def _matches(record: dict, cfg) -> bool:
+    return (record.get("format") == FORMAT
+            and record.get("dataset") == cfg.data.dataset
+            and record.get("image_size") == cfg.data.resolved_image_size
+            and record.get("batch") == int(cfg.serve.calibration_batch))
+
+
+def ensure_calibration(cfg, directory: str) -> dict:
+    """Load a matching digest-valid ``calibration.json`` from
+    ``directory``, or run the calibration pass and write one. The
+    load-or-collect shape makes quantized serve replicas and scenario
+    drills self-contained: first boot calibrates, restarts reuse."""
+    try:
+        record = load_calibration(directory)
+        if _matches(record, cfg):
+            return record
+    except (OSError, ValueError, json.JSONDecodeError):
+        pass
+    record = collect_ranges(cfg)
+    write_calibration(record, directory)
+    return record

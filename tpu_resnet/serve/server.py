@@ -179,6 +179,7 @@ class PredictServer:
             daemon=True)
         self._closed = False
         self._oom_reported = False
+        self._weight_bytes = 0  # published at start(); backend-derived
 
     def note_oom(self, error, phase: str = "infer") -> None:
         """OOM forensics for the serving process (obs/memory.py): the
@@ -232,6 +233,16 @@ class PredictServer:
                         bucket=int(b),
                         cache_hit=bool(info.get("cache_hit")))
                     self.registry.set("serve_buckets_warm", float(n))
+        # Weight-argument footprint of the (possibly quantized) bucket
+        # programs — the live end of the golden-memory-twin story: a
+        # quantized arm's serve_weight_bytes gauge reads ~0.25x its f32
+        # twin's, and the A/B scenario feeds it to perfwatch as a
+        # lower-is-better series (tools/perfwatch.py `_bytes` rule).
+        wb_fn = getattr(self.backend, "weight_argument_bytes", None)
+        if wb_fn is not None:
+            self._weight_bytes = int(wb_fn())
+            self.registry.set("serve_weight_bytes",
+                              float(self._weight_bytes))
         stats_fn = getattr(self.backend, "program_cache_stats", None)
         cache_stats = stats_fn() if stats_fn is not None else {}
         ttr = time.monotonic() - self._t_init
@@ -454,6 +465,15 @@ class PredictServer:
             "image_shape": list(self.image_shape),
             "num_classes": int(self.backend.num_classes),
             "buckets": list(self.buckets),
+            # Arm identity (the router A/B scenario and fleetmon label
+            # arms from here — no out-of-band config): numeric compute
+            # dtype, quant mode, and the calibration digest the
+            # quantized weights were built from.
+            "compute_dtype": self.cfg.model.compute_dtype,
+            "quantize": getattr(self.backend, "quantize", "off"),
+            "calibration_digest": getattr(self.backend,
+                                          "calibration_digest", ""),
+            "weight_bytes": int(self._weight_bytes),
             "max_wait_ms": self.cfg.serve.max_wait_ms,
             "max_queue": self.cfg.serve.max_queue,
             # Top-level copy: the router's passive queue-pressure signal
@@ -542,18 +562,24 @@ class PredictServer:
 
 def write_discovery(train_dir: str, port: int,
                     run_id: Optional[str] = None,
-                    name: str = "") -> None:
+                    name: str = "",
+                    extra: Optional[dict] = None) -> None:
     """Atomic ``<train_dir>/serve.json`` — the telemetry.json analog for
     the predict server (loadgen/doctor dial the port from here). A
     nonempty ``name`` (serve.replica_name) writes
     ``serve-<name>.json`` instead, so N replicas sharing one train_dir
     each announce themselves and the router (serve/router.py) discovers
-    the whole fleet from one directory scan."""
+    the whole fleet from one directory scan. ``extra`` fields ride along
+    in the record — the server announces its arm identity (compute
+    dtype / quant mode) here so the router scenario and fleetmon can
+    label arms from the discovery scan alone."""
     from tpu_resnet.serve.discovery import write_record
 
+    record = {"run_id": run_id, "name": name or None}
+    record.update(extra or {})
     write_record(train_dir,
                  f"serve-{name}.json" if name else SERVE_DISCOVERY,
-                 port, extra={"run_id": run_id, "name": name or None})
+                 port, extra=record)
 
 
 def read_serve_port(train_dir: str) -> Optional[int]:
@@ -610,7 +636,12 @@ def serve(cfg: RunConfig) -> int:
             raise
         write_discovery(cfg.train.train_dir, server.port,
                         run_id=server.run_id,
-                        name=cfg.serve.replica_name)
+                        name=cfg.serve.replica_name,
+                        extra={
+                            "compute_dtype": cfg.model.compute_dtype,
+                            "quantize": getattr(server.backend,
+                                                "quantize", "off"),
+                        })
         log.info("serve: ready on :%d — backend=%s model_step=%d "
                  "buckets=%s max_wait_ms=%s (POST /predict; /metrics; "
                  "/healthz)", server.port, cfg.serve.backend,
