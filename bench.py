@@ -329,14 +329,43 @@ def _train_step_flops(compiled):
         return None
 
 
+def _train_step_comms(compiled, mesh):
+    """Bench fields from the compiled step's collective summary
+    (obs/comms.py over the post-partitioner HLO): per-device
+    bytes-on-wire per step (the perfwatch sweep-comm series,
+    lower-is-better), collective count and — when the chip's ICI
+    bandwidth is known — the predicted time-on-wire. {} if the backend
+    reports no HLO; bench lines then omit the comms fields, like mfu
+    without a peak."""
+    from tpu_resnet.obs.comms import (comms_from_compiled, ici_bytes_per_chip,
+                                      predicted_time_on_wire)
+
+    try:
+        shape = dict(mesh.shape)
+        summary = comms_from_compiled(compiled, shape.get("data", 1),
+                                      shape.get("model", 1))
+    except Exception:
+        return {}
+    if summary is None:
+        return {}
+    out = {"comms_bytes_per_step": summary["wire_bytes_per_device"],
+           "comms_collective_count": summary["collective_count"]}
+    kind = mesh.devices.flat[0].device_kind
+    if ici_bytes_per_chip(kind):
+        out["predicted_time_on_wire_s"] = round(
+            predicted_time_on_wire(summary, kind), 6)
+    return out
+
+
 def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
                       batch=128, image=224, dtype="bfloat16",
                       stem_s2d=None, mutate_cfg=None):
     """ImageNet-shaped training step: ResNet-50 @ 224, batch 128, bf16,
     synthetic pre-processed input resident on device. Returns
-    (steps/s, flops_per_step or None). ``stem_s2d`` overrides
-    model.stem_space_to_depth (None = config default) for the stem A/B;
-    ``mutate_cfg`` as in ``_build_train_setup``."""
+    (steps/s, flops_per_step or None, comms bench fields — possibly {}).
+    ``stem_s2d`` overrides model.stem_space_to_depth (None = config
+    default) for the stem A/B; ``mutate_cfg`` as in
+    ``_build_train_setup``."""
     import jax
     import numpy as np
 
@@ -371,6 +400,7 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
     # the measured step is the production configuration.
     compiled = step_fn.lower(state, images, labels).compile()
     flops = _train_step_flops(compiled)
+    comms = _train_step_comms(compiled, mesh)
 
     for _ in range(warmup_steps):
         state, metrics = compiled(state, images, labels)
@@ -381,7 +411,7 @@ def _measure_imagenet(mesh, warmup_steps, measure_steps, resnet_size=50,
         state, metrics = compiled(state, images, labels)
     _fetch_sync(metrics["loss"])
     dt = time.perf_counter() - t0
-    return measure_steps / dt, flops
+    return measure_steps / dt, flops, comms
 
 
 def _synthetic_photo_jpeg(size=(640, 480), quality=90, rng=None,
@@ -690,9 +720,10 @@ def run_child(kind: str) -> None:
 
         if fits("imagenet"):
             try:
-                inet_sps, flops = _measure_imagenet(mesh, warmup_steps=5,
-                                                    measure_steps=30)
+                inet_sps, flops, comms = _measure_imagenet(
+                    mesh, warmup_steps=5, measure_steps=30)
                 entry = imagenet_entry(inet_sps, flops, 128)
+                entry.update(comms)
                 entry["metric"] = \
                     "imagenet_resnet50_train_steps_per_sec_b128"
                 entry["vs_baseline"] = round(
@@ -712,9 +743,10 @@ def run_child(kind: str) -> None:
             b2 = 0
         if b2 and fits(f"imagenet_b{b2}"):
             try:
-                sps2, flops2 = _measure_imagenet(
+                sps2, flops2, comms2 = _measure_imagenet(
                     mesh, warmup_steps=3, measure_steps=15, batch=b2)
                 result[f"imagenet_b{b2}"] = imagenet_entry(sps2, flops2, b2)
+                result[f"imagenet_b{b2}"].update(comms2)
                 print(f"[bench child] imagenet b{b2}: {sps2:.3f} steps/s "
                       f"mfu={result[f'imagenet_b{b2}'].get('mfu')}",
                       file=sys.stderr)
@@ -726,9 +758,9 @@ def run_child(kind: str) -> None:
         # buys on this chip at the headline batch.
         if fits("imagenet_stem_ab"):
             try:
-                sps_plain, _ = _measure_imagenet(mesh, warmup_steps=3,
-                                                 measure_steps=15,
-                                                 stem_s2d=False)
+                sps_plain, _, _ = _measure_imagenet(mesh, warmup_steps=3,
+                                                    measure_steps=15,
+                                                    stem_s2d=False)
                 base = result.get("imagenet", {}).get("value")
                 result["imagenet_stem_ab"] = {
                     "plain_stem_steps_per_sec": round(sps_plain, 3),

@@ -1,0 +1,20 @@
+"""Seeded sharding-scope violation: a helper constructing NamedSharding
+and pinning layouts with with_sharding_constraint outside the
+partitioner-owned modules — the bypass pattern the sharding-scope lint
+exists to catch (a sharding injected here changes the compiled
+program's collective structure behind the golden comms ledgers' back)."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def sneaky_shard(mesh, tree):
+    # NamedSharding construction outside the partitioner scope
+    sharding = NamedSharding(mesh, P("data"))
+    return jax.device_put(tree, sharding)
+
+
+def sneaky_constraint(mesh, grads):
+    # with_sharding_constraint outside the partitioner scope
+    return jax.lax.with_sharding_constraint(
+        grads, NamedSharding(mesh, P(None, "data")))

@@ -585,6 +585,25 @@ def test_registry_scope_fixture_flags_direct_jit_construction():
     assert not clean
 
 
+def test_sharding_scope_fixture_flags_stray_sharding_construction():
+    """The sharding-scope anti-pattern stays flagged: NamedSharding /
+    with_sharding_constraint built outside the partitioner-owned modules
+    — a layout decided there is invisible to StatePartitioner's rules,
+    the golden memory/collectives engines, and the zero1 twin gates."""
+    found = fixture_findings("sharding_scope_bad", "sharding-scope")
+    assert len(found) == 3, found
+    assert {f.line for f in found} == {13, 19, 20}
+    assert all(f.path == "tpu_resnet/obs/layout_hack.py" for f in found)
+    assert "StatePartitioner" in found[0].message
+    # the partitioner-owned modules themselves stay silent
+    from tpu_resnet.analysis.jaxlint import (SHARDING_SCOPE_FILES,
+                                             run_jaxlint as _lint)
+
+    clean = _lint(REPO, select=["sharding-scope"],
+                  files=list(SHARDING_SCOPE_FILES))
+    assert not clean
+
+
 def test_route_fixture_flags_jax_import_and_handler_teardown():
     """The fleet-router anti-patterns stay flagged: a module-scope jax
     import in the host-isolated router (it must come up on a host whose

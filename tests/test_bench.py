@@ -81,11 +81,16 @@ def test_measure_cifar_streaming_smoke(mesh):
 
 @pytest.mark.slow
 def test_measure_imagenet_smoke(mesh):
-    sps, flops = bench._measure_imagenet(
+    sps, flops, comms = bench._measure_imagenet(
         mesh, warmup_steps=1, measure_steps=2, resnet_size=18, batch=16,
         image=64, dtype="float32")
     assert sps > 0
     assert flops is None or flops > 0
+    # single-device mesh: the compiled step is collective-free, and the
+    # comms fields (when the backend reports HLO) must say exactly that.
+    if comms:
+        assert comms["comms_bytes_per_step"] == 0
+        assert comms["comms_collective_count"] == 0
 
 
 def test_peak_flops_table():

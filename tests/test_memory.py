@@ -451,11 +451,23 @@ def test_loop_ledger_gauges_and_oom_report(tmp_path, monkeypatch):
         ledger = json.load(f)["entries"]
     with open(os.path.join(cfg.train.train_dir, "flops.json")) as f:
         flops = json.load(f)["entries"]
-    assert sorted(ledger) == sorted(flops)  # one key spelling, twice
+    with open(os.path.join(cfg.train.train_dir, "comms.json")) as f:
+        comms_ledger = json.load(f)["entries"]
+    # one key spelling, three times: flops / memory / comms certify the
+    # same compiled programs
+    assert sorted(ledger) == sorted(flops) == sorted(comms_ledger)
     (entry,) = ledger.values()
     assert entry["argument_bytes"] > 0 and entry["temp_bytes"] > 0
     assert entry["alias_bytes"] > 0  # loop step donates its state
     assert "program" in entry  # which program shape the budget describes
+    (comms_entry,) = comms_ledger.values()
+    assert comms_entry["comms_source"] == "compiled_hlo"
+    # smoke runs on the virtual 8-way data mesh: the gradient sync is on
+    # the wire and the prober sees it in the compiled HLO
+    assert comms_entry["n_devices"] == 8
+    assert comms_entry["collective_count"] > 0
+    assert comms_entry["wire_bytes_per_device"] > 0
+    assert comms_entry["program"] == entry["program"]
 
     hbm_records = [r for r in map(
         json.loads, open(os.path.join(cfg.train.train_dir,

@@ -202,3 +202,51 @@ def test_sweep_hbm_per_point_gating(tmp_path):
                               metric_names=names)
     assert verdict["metrics"]["sweep-mem:p1"]["verdict"] == "regress"
     assert verdict["overall"] == "regress"
+
+
+def test_sweep_comm_per_point_gating(tmp_path):
+    """Every sweep point's comms_bytes_per_step becomes a lower-is-
+    better sweep-comm: sample — a collective-bytes CUT (a zero1/ZeRO-2
+    win) reports improve, growth (a stray gather landing) regresses,
+    noise-band wobble stays flat."""
+    def traj(path, wire):
+        with open(path, "w") as f:
+            json.dump({"points": [{"id": "p1", "status": "ok",
+                                   "steps_per_sec": 100.0,
+                                   "comms_bytes_per_step": wire,
+                                   "backend": "tpu"}]}, f)
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    traj(a, 4_000_000)
+    traj(b, 2_000_000)  # exchange landed: ~2x wire cut
+    samples = perfwatch.load_sweep_samples([a, b])
+    names = sorted({s["metric"] for s in samples})
+    assert "sweep-comm:p1" in names
+    verdict = perfwatch.judge(samples, noise=0.08, metric_names=names)
+    m = verdict["metrics"]["sweep-comm:p1"]
+    assert m["direction"] == "lower_is_better"
+    assert m["verdict"] == "improve"
+
+    traj(b, 4_100_000)  # inside the noise band
+    samples = perfwatch.load_sweep_samples([a, b])
+    verdict = perfwatch.judge(samples, noise=0.08, metric_names=names)
+    assert verdict["metrics"]["sweep-comm:p1"]["verdict"] == "flat"
+
+    traj(b, 8_000_000)  # stray gather doubled the wire: gate
+    samples = perfwatch.load_sweep_samples([a, b])
+    verdict = perfwatch.judge(samples, noise=0.08, metric_names=names)
+    assert verdict["metrics"]["sweep-comm:p1"]["verdict"] == "regress"
+    assert verdict["overall"] == "regress"
+
+
+def test_sweep_comm_absent_field_yields_no_series(tmp_path):
+    """Old trajectory files (pre-comms bench) must not grow a bogus
+    sweep-comm: series."""
+    path = str(tmp_path / "a.json")
+    with open(path, "w") as f:
+        json.dump({"points": [{"id": "p1", "status": "ok",
+                               "steps_per_sec": 100.0,
+                               "backend": "tpu"}]}, f)
+    samples = perfwatch.load_sweep_samples([path])
+    assert not any(s["metric"].startswith(perfwatch.SWEEP_COMM_PREFIX)
+                   for s in samples)

@@ -177,6 +177,39 @@ def test_list_covers_scenario_files_and_legacy_probes(capsys):
         assert f"tools/doctor.py --{probe.replace('_', '-')}" in out
 
 
+def test_catalog_parity_disk_validate_and_doctor_listing(capsys):
+    """Catalog-parity gate across every surface: each scenarios/* file
+    is cataloged with an existing path, each passes schema validation,
+    and `doctor --list-probes` round-trips the SAME inventory as
+    `scenario list` (both read catalog.list_scenarios — main.py routes
+    the doctor flag there) including the legacy bespoke probes."""
+    import subprocess
+
+    entries = catalog.list_scenarios()
+    assert entries
+    on_disk = {f for f in os.listdir(catalog.scenarios_dir())
+               if f.endswith((".json", ".toml"))}
+    assert {os.path.basename(s["path"]) for s in entries} == on_disk
+    for s in entries:
+        assert os.path.exists(s["path"]), s["name"]
+        assert s["description"] != "(unparseable scenario file)", s["name"]
+    names = [s["name"] for s in entries]
+    assert cli.main(["validate"] + names) == 0
+    capsys.readouterr()
+    assert cli.main(["list", "--paths"]) == 0
+    listed = capsys.readouterr().out
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpu_resnet", "doctor", "--list-probes"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout
+    for name in names:
+        assert name in listed and name in proc.stdout, name
+    for probe in catalog.LEGACY_PROBES:
+        flag = probe.replace("_", "-")
+        assert f"--{flag}" in listed and f"--{flag}" in proc.stdout, probe
+
+
 # ------------------------------------------- child argv/env construction
 
 def test_build_argv_cmd_is_verbatim_copy():

@@ -66,7 +66,7 @@ def main() -> int:
             if args.batch_tile is not None:
                 cfg.model.fused_block_tile = args.batch_tile
         if args.preset == "imagenet":
-            sps, _flops = bench._measure_imagenet(
+            sps, _flops, _comms = bench._measure_imagenet(
                 mesh, args.warmup_steps, args.measure_steps,
                 resnet_size=args.resnet_size or 50, batch=args.batch,
                 image=args.image, mutate_cfg=mutate)

@@ -84,6 +84,12 @@ SWEEP_LAT_PREFIX = "sweep-lat:"
 # gates with zero glue. Direction comes from the metric's unit suffix —
 # _ms/_bytes/_s name costs (lower is better), everything else a rate.
 SWEEP_SCN_PREFIX = "sweep-scn:"
+# Bytes-on-wire twin (lower-is-better): bench records carry
+# comms_bytes_per_step from the compiled step's collective summary
+# (obs/comms.py) — a knob that "wins" throughput by inflating the
+# per-step collective traffic gates as regress, the same contract as
+# the peak-HBM series.
+SWEEP_COMM_PREFIX = "sweep-comm:"
 
 
 def _lower_is_better(name: str) -> bool:
@@ -91,7 +97,7 @@ def _lower_is_better(name: str) -> bool:
         return name.endswith(("_ms", "_bytes", "_s"))
     return (name in LOWER_IS_BETTER
             or name.startswith((SWEEP_MEM_PREFIX, SWEEP_TTR_PREFIX,
-                                SWEEP_LAT_PREFIX)))
+                                SWEEP_LAT_PREFIX, SWEEP_COMM_PREFIX)))
 
 
 def salvage_result(text: str) -> Optional[dict]:
@@ -341,6 +347,17 @@ def load_sweep_samples(paths: List[str]) -> List[dict]:
                     "metric": f"{SWEEP_LAT_PREFIX}{point.get('id')}",
                     "backend": backend,
                     "value": float(lat), "partial": False})
+            # Bytes-on-wire twin (lower-is-better): the compiled step's
+            # per-step collective traffic (obs/comms.py summary via
+            # bench) — a throughput "win" that inflates wire traffic
+            # gates as regress before it ever meets a real pod.
+            comm = point.get("comms_bytes_per_step")
+            if isinstance(comm, (int, float)) and comm > 0:
+                samples.append({
+                    "source": os.path.basename(path), "order": idx,
+                    "metric": f"{SWEEP_COMM_PREFIX}{point.get('id')}",
+                    "backend": backend,
+                    "value": float(comm), "partial": False})
             # Scenario-conductor series: the point id already carries
             # "<scenario>:<metric>"; direction is derived from the
             # metric's unit suffix in _lower_is_better.

@@ -3,14 +3,19 @@
     python -m tpu_resnet check                 # lints + concurrency +
                                                #   spmd + config matrix
                                                #   + golden memory budgets
+                                               #   + golden collectives
     python -m tpu_resnet check --skip-matrix   # AST engines only
                                                #   (seconds, no jax)
     python -m tpu_resnet check --skip-memory   # skip the XLA-compile-
                                                #   backed memory engine
+    python -m tpu_resnet check --skip-collectives
+                                               # skip the collective-
+                                               #   communication engine
     python -m tpu_resnet check --skip-concurrency --skip-spmd
                                                # PR-4-era engine set
     python -m tpu_resnet check --update-golden # intentional regeneration
-                                               #   (jaxprs AND memory)
+                                               #   (jaxprs, memory AND
+                                               #   collectives, one pass)
     tpu-resnet-check                           # console-script alias
 
 Exit code 0 = clean (after pragmas + baseline), 1 = error findings (or a
@@ -99,15 +104,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the golden memory-budget engine (it pays "
                         "real XLA compiles — minutes for the full "
                         "matrix; the jaxpr trace stays)")
+    p.add_argument("--skip-collectives", action="store_true",
+                   help="skip the collective-communication engine "
+                        "(analysis/collectives.py; shares the memory "
+                        "engine's compiles, so skipping it saves "
+                        "compile time only when --skip-memory is also "
+                        "set)")
     p.add_argument("--update-golden", action="store_true",
-                   help="rewrite analysis/golden_jaxprs.json AND "
-                        "analysis/golden_memory.json from the current "
-                        "programs (intentional program changes; commit "
-                        "the diff and say why)")
+                   help="rewrite analysis/golden_jaxprs.json, "
+                        "analysis/golden_memory.json AND "
+                        "analysis/golden_collectives.json from the "
+                        "current programs in one coherent pass "
+                        "(intentional program changes; commit the diff "
+                        "and say why)")
     p.add_argument("--golden", default=None,
                    help="alternate golden_jaxprs.json path")
     p.add_argument("--golden-memory", default=None,
                    help="alternate golden_memory.json path")
+    p.add_argument("--golden-collectives", default=None,
+                   help="alternate golden_collectives.json path")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="baseline file of accepted findings "
                         "(default: analysis/baseline.json)")
@@ -138,6 +153,20 @@ def main(argv=None) -> int:
               "golden_memory.json (memorybudget.py)")
         print("memory-budget      memory-budget engine failures "
               "(entry failed to compile)")
+        print("golden-collectives-drift compiled-program collective "
+              "structure/bytes-on-wire drift vs golden_collectives.json "
+              "(collectives.py)")
+        print("stray-gather       replicated-mode program all-gathers "
+              "parameter-scale payloads (collectives.py)")
+        print("axis-confinement   2-D mesh collective spans both mesh "
+              "axes without covering the full mesh (collectives.py)")
+        print("collective-free-serve serve-bucket program contains a "
+              "collective (collectives.py)")
+        print("zero1-exchange     zero1 reduce-scatter/all-gather "
+              "exchange missing or not replacing the gradient "
+              "all-reduce (collectives.py)")
+        print("collectives-budget collectives engine failures "
+              "(entry failed to compile)")
         return 0
 
     root = args.root or _default_root()
@@ -154,8 +183,8 @@ def main(argv=None) -> int:
     # they can neither judge baseline entries stale nor rewrite the
     # baseline wholesale without deleting the other engines' entries.
     full_run = not (args.skip_lint or args.skip_matrix
-                    or args.skip_memory or args.skip_concurrency
-                    or args.skip_spmd or select)
+                    or args.skip_memory or args.skip_collectives
+                    or args.skip_concurrency or args.skip_spmd or select)
 
     def _subset(rules):
         """--rules subset owned by one AST engine (None = all of it;
@@ -225,6 +254,26 @@ def main(argv=None) -> int:
             if args.update_golden:
                 print(f"updated {len(mem_stats['updated'])} golden "
                       f"memory budgets in {mem_golden}")
+        if not args.skip_collectives:
+            # Engine 5: collective structure + bytes-on-wire. Shares
+            # memorybudget's per-entry compile cache, so running it
+            # after the memory engine costs parsing, not compiles.
+            from tpu_resnet.analysis import collectives
+
+            comms_golden = (args.golden_collectives
+                            or collectives.GOLDEN_PATH)
+            comms_findings, comms_stats = collectives.verify_collectives(
+                update_golden=args.update_golden,
+                golden_path=comms_golden)
+            findings += comms_findings
+            stats["collectives"] = {k: v for k, v in comms_stats.items()
+                                    if k != "updated"}
+            checked.append(
+                f"collectives: {comms_stats['compiled']} compiled, "
+                f"{comms_stats['compared']} compared")
+            if args.update_golden:
+                print(f"updated {len(comms_stats['updated'])} golden "
+                      f"collective summaries in {comms_golden}")
 
     if args.write_baseline:
         # A partial run MERGES: entries owned by engines/rules that
@@ -238,6 +287,10 @@ def main(argv=None) -> int:
             matrix_rules = {"config-matrix", "golden-jaxpr-drift",
                             "registry-coverage"}
             memory_rules = {"golden-memory-drift", "memory-budget"}
+            collectives_rules = {"golden-collectives-drift",
+                                 "stray-gather", "axis-confinement",
+                                 "collective-free-serve",
+                                 "zero1-exchange", "collectives-budget"}
             selected = set(select) if select else None
 
             def ran(rule: str) -> bool:
@@ -245,6 +298,9 @@ def main(argv=None) -> int:
                     return not args.skip_matrix
                 if rule in memory_rules:
                     return not (args.skip_matrix or args.skip_memory)
+                if rule in collectives_rules:
+                    return not (args.skip_matrix
+                                or args.skip_collectives)
                 if rule in CONCURRENCY_RULES:
                     return (not args.skip_concurrency
                             and (selected is None or rule in selected))

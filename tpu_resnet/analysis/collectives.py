@@ -1,0 +1,380 @@
+"""Golden collective-communication budgets — check engine 5, the wire
+twin of the golden-memory verifier.
+
+The config-matrix verifier pins WHAT program each supported
+configuration compiles to, golden memory pins what it costs in HBM;
+this engine pins what it puts ON THE WIRE. For every traced matrix
+entry it compiles the real program on a concrete CPU mesh (one shared
+compile with the memory engine — ``memorybudget.entry_artifacts``),
+extracts every collective op from the post-SPMD-partitioner HLO
+(``obs/comms.py``: op, payload bytes, replica groups in both HLO
+spellings, mesh-axis bucket, ring-model bytes-on-wire) and compares the
+summary against ``analysis/golden_collectives.json`` — tolerance bands
+on byte totals, exact compare on the op multiset and structure
+signature, ``--update-golden`` regen, empty-baseline merge rules:
+exactly the golden-memory workflow.
+
+Named rules (docs/CHECKS.md has the catalog):
+
+``golden-collectives-drift``  op multiset / structure signature differs
+                              from golden, byte totals leave the band,
+                              or an entry has no golden recorded.
+``stray-gather``              a replicated-mode train program all-
+                              gathers parameter-scale payloads — the
+                              ZeRO-bloat regression (replicated state
+                              must never be re-gathered).
+``axis-confinement``          a 2-D mesh program emits a collective
+                              whose replica groups span BOTH mesh axes
+                              without being a full-mesh group — the
+                              pod-hang/pod-slow class (arXiv:2211.05102;
+                              model-axis traffic must stay inside its
+                              row).
+``collective-free-serve``     serve-bucket programs (incl. the ``_q8``
+                              family) must contain ZERO collectives — a
+                              collective in a serve program is a fleet-
+                              wide hang the moment replicas stop being
+                              single-process.
+``zero1-exchange``            the zero1 twins must show reduce-scatter
+                              + all-gather REPLACING the gradient
+                              all-reduce (bytes-ratio gated against the
+                              analytic param footprint and the
+                              replicated twin) — the comms dual of the
+                              ZeRO-1 0.125x memory gate, and the
+                              template ZeRO-2/3 will extend.
+``collectives-budget``        a supported entry failed to compile for
+                              its comms summary (per-entry, one broken
+                              row never costs the rest).
+
+Budgets are defined over the CPU compile (tier-1/CI environment, same
+rule as the jaxpr/memory goldens). XLA's CPU pipeline decomposes
+reduce-scatter into all-reduce + slice; the extractor re-derives the
+logical op from consumer shapes (see ``obs/comms.py``), so the golden
+structure means the same thing CPU and TPU. Off-CPU the compare is
+skipped with a warning. Regenerate intentionally with ``python -m
+tpu_resnet check --update-golden`` and say why in the PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from tpu_resnet.analysis.configmatrix import MATRIX, MatrixEntry
+from tpu_resnet.analysis.findings import Finding
+from tpu_resnet.obs.comms import summarize_collectives
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__),
+                           "golden_collectives.json")
+GOLDEN_FORMAT = 1
+# Byte totals get the golden-memory band: the ring-model arithmetic is
+# deterministic, but payload rounding across jax/XLA releases (fusion of
+# small reductions, combined BN-stat tuples) can shuffle a few percent.
+# The STRUCTURE (op multiset, signatures, axis buckets) compares exactly
+# — structure drift is never compiler noise.
+DEFAULT_TOLERANCE = 0.10
+SLACK_BYTES = 4096
+
+# Banded byte components of a collectives summary.
+BYTE_COMPONENTS = ("wire_bytes_per_device", "all_gather_bytes",
+                   "reduce_scatter_bytes", "plain_all_reduce_bytes")
+
+# zero1-exchange gates, as fractions of the analytic replicated param
+# footprint (params_argument_bytes, exact partitioner arithmetic):
+# the scattered/gathered float bytes must each cover most of the
+# parameters (momentum exchange = one scatter + one gather of every
+# divisible leaf; BN moments and axis-undivisible leaves stay plain,
+# hence < 1.0), and the plain float all-reduce bytes must have DROPPED
+# well below the replicated twin's (the "replacing" proof).
+ZERO1_MIN_EXCHANGE_FRACTION = 0.75
+ZERO1_MAX_PLAIN_FRACTION = 0.50
+
+# stray-gather fires when a non-zero1 train program all-gathers float
+# payloads at parameter scale — small halo/metric gathers stay legal.
+STRAY_GATHER_FRACTION = 0.25
+
+
+def entry_comms_summary(entry: MatrixEntry) -> dict:
+    """Compile ``entry`` (shared, cached compile —
+    ``memorybudget.entry_artifacts``) and summarize its collectives.
+    The summary carries ``params_argument_bytes`` from the memory
+    budget so the zero1/stray-gather gates can compare wire traffic
+    against the analytic parameter footprint without a second source of
+    truth."""
+    from tpu_resnet.analysis import memorybudget
+
+    art = memorybudget.entry_artifacts(entry)
+    if art["hlo_text"] is None:
+        raise RuntimeError("backend reported no HLO text for the "
+                           "compiled program")
+    summary = summarize_collectives(art["hlo_text"], entry.data_axis,
+                                    entry.model_axis)
+    summary["partition"] = entry.partition
+    budget = art["budget"]
+    summary["params_argument_bytes"] = int(
+        budget.get("params_argument_bytes")
+        or budget.get("weight_argument_bytes") or 0)
+    return summary
+
+
+# ----------------------------------------------------------- named rules
+def _rule_collective_free_serve(entry: MatrixEntry,
+                                summary: dict) -> List[Finding]:
+    if entry.builder != "serve":
+        return []
+    if summary["collective_count"] == 0:
+        return []
+    ops = ", ".join(f"{op} x{n}" for op, n in summary["ops"].items())
+    return [Finding(
+        "collective-free-serve", f"<golden-collectives>/{entry.name}", 0,
+        f"serve-bucket program contains {summary['collective_count']} "
+        f"collective(s) ({ops}) — serve programs must be collective-free: "
+        f"any cross-device op in the inference path becomes a fleet-wide "
+        f"hang the moment replicas stop being single-process "
+        f"(serve/infer.py replicates weights; nothing it computes may "
+        f"synchronize devices)")]
+
+
+def _rule_stray_gather(entry: MatrixEntry, summary: dict) -> List[Finding]:
+    if entry.builder == "serve" or entry.partition == "zero1":
+        return []
+    params = summary.get("params_argument_bytes", 0)
+    ag = summary.get("all_gather_bytes", 0)
+    if not params or ag < STRAY_GATHER_FRACTION * params:
+        return []
+    return [Finding(
+        "stray-gather", f"<golden-collectives>/{entry.name}", 0,
+        f"replicated-mode program all-gathers {ag:,} float bytes "
+        f"(>= {STRAY_GATHER_FRACTION:.0%} of the {params:,}-byte param "
+        f"footprint) — replicated state must never be re-gathered: this "
+        f"is the ZeRO-bloat regression (a sharding constraint leaked "
+        f"into a replicated program, paying ZeRO's exchange without its "
+        f"memory cut)")]
+
+
+def _rule_axis_confinement(entry: MatrixEntry,
+                           summary: dict) -> List[Finding]:
+    if entry.model_axis <= 1:
+        return []
+    mixed = summary.get("bytes_by_axis", {}).get("mixed")
+    if not mixed:
+        return []
+    return [Finding(
+        "axis-confinement", f"<golden-collectives>/{entry.name}", 0,
+        f"2-D mesh program moves {mixed:,} bytes on collectives whose "
+        f"replica groups span BOTH mesh axes without covering the full "
+        f"mesh — model-axis traffic must stay inside its mesh row "
+        f"(groups varying only the model coordinate) and gradient "
+        f"traffic inside its column; a diagonal group serializes the "
+        f"ICI links both ways (the pod-slow class, arXiv:2211.05102)")]
+
+
+def _rule_zero1_exchange(entry: MatrixEntry, summary: dict,
+                         twin: Optional[dict]) -> List[Finding]:
+    if entry.partition != "zero1" or entry.data_axis <= 1:
+        return []
+    path = f"<golden-collectives>/{entry.name}"
+    params = summary.get("params_argument_bytes", 0)
+    findings: List[Finding] = []
+    floor = ZERO1_MIN_EXCHANGE_FRACTION * params
+    for comp, label in (("reduce_scatter_bytes", "reduce-scatter"),
+                        ("all_gather_bytes", "all-gather")):
+        got = summary.get(comp, 0)
+        if got < floor:
+            findings.append(Finding(
+                "zero1-exchange", path, 0,
+                f"zero1 program {label}s only {got:,} float bytes, below "
+                f"{ZERO1_MIN_EXCHANGE_FRACTION:.0%} of the {params:,}-"
+                f"byte param footprint — the ZeRO-1 exchange (scatter "
+                f"the gradient, gather the updated shard) is missing or "
+                f"degraded; the partitioner's constraints "
+                f"(parallel/zero.py zero1_update) are not reaching the "
+                f"compiled program"))
+    plain = summary.get("plain_all_reduce_bytes", 0)
+    ceiling = ZERO1_MAX_PLAIN_FRACTION * (
+        twin.get("plain_all_reduce_bytes", 0) if twin else 0)
+    if twin and plain > ceiling:
+        findings.append(Finding(
+            "zero1-exchange", path, 0,
+            f"zero1 program still moves {plain:,} float bytes as PLAIN "
+            f"all-reduce vs {twin.get('plain_all_reduce_bytes', 0):,} in "
+            f"its replicated twin (gate: < "
+            f"{ZERO1_MAX_PLAIN_FRACTION:.0%}) — reduce-scatter + "
+            f"all-gather must REPLACE the gradient all-reduce, not ride "
+            f"alongside it; only BN moments and axis-undivisible leaves "
+            f"may stay plain"))
+    return findings
+
+
+def apply_rules(entry: MatrixEntry, summary: dict,
+                twin: Optional[dict] = None) -> List[Finding]:
+    """Every semantic rule over one entry's comms summary. ``twin`` is
+    the replicated twin's summary for zero1 rows (found by stripping
+    ``_zero1`` from the entry name), when it compiled this run."""
+    findings: List[Finding] = []
+    findings.extend(_rule_collective_free_serve(entry, summary))
+    findings.extend(_rule_stray_gather(entry, summary))
+    findings.extend(_rule_axis_confinement(entry, summary))
+    findings.extend(_rule_zero1_exchange(entry, summary, twin))
+    return findings
+
+
+# ------------------------------------------------------- golden workflow
+def _compare(name: str, want: dict, got: dict,
+             tolerance: float) -> List[Finding]:
+    path = f"<golden-collectives>/{name}"
+    findings: List[Finding] = []
+    for comp in ("ops", "structure"):
+        w, g = want.get(comp, {}), got.get(comp, {})
+        if w == g:
+            continue
+        gone = sorted(set(w) - set(g))
+        new = sorted(set(g) - set(w))
+        moved = sorted(k for k in set(w) & set(g) if w[k] != g[k])
+        detail = "; ".join(
+            s for s in (f"removed: {', '.join(gone)}" if gone else "",
+                        f"added: {', '.join(new)}" if new else "",
+                        f"recount: {', '.join(f'{k} {w[k]}->{g[k]}' for k in moved)}"
+                        if moved else "") if s)
+        findings.append(Finding(
+            "golden-collectives-drift", path, 0,
+            f"collective {comp} drifted from golden ({detail}) — the "
+            f"compiled program's communication structure changed. If "
+            f"intended (new partition rule, optimizer change), "
+            f"regenerate via `python -m tpu_resnet check "
+            f"--update-golden` and say why in the PR; structure is "
+            f"exact, never compiler noise"))
+    for comp in BYTE_COMPONENTS:
+        w = int(want.get(comp, 0) or 0)
+        g = int(got.get(comp, 0) or 0)
+        if abs(g - w) <= max(tolerance * max(w, g), SLACK_BYTES):
+            continue
+        ratio = g / w if w else float("inf")
+        findings.append(Finding(
+            "golden-collectives-drift", path, 0,
+            f"{comp} drifted {w:,} -> {g:,} bytes ({ratio:.2f}x), "
+            f"outside the ±{tolerance:.0%} band — the program's bytes-"
+            f"on-wire changed. If intended, regenerate via `python -m "
+            f"tpu_resnet check --update-golden` and say why; if not, "
+            f"this is a silent comms regression caught at review time"))
+    wa = {k: int(v) for k, v in want.get("bytes_by_axis", {}).items()}
+    ga = {k: int(v) for k, v in got.get("bytes_by_axis", {}).items()}
+    for axis in sorted(set(wa) | set(ga)):
+        w, g = wa.get(axis, 0), ga.get(axis, 0)
+        if abs(g - w) > max(tolerance * max(w, g), SLACK_BYTES):
+            findings.append(Finding(
+                "golden-collectives-drift", path, 0,
+                f"bytes on the '{axis}' mesh axis drifted {w:,} -> "
+                f"{g:,} — traffic moved between mesh axes relative to "
+                f"golden. If intended, regenerate via `python -m "
+                f"tpu_resnet check --update-golden` and say why"))
+    return findings
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return {"format": GOLDEN_FORMAT, "entries": {}}
+
+
+def save_golden(golden: dict, path: str = GOLDEN_PATH) -> None:
+    golden["entries"] = dict(sorted(golden["entries"].items()))
+    with open(path, "w") as fh:
+        json.dump(golden, fh, indent=1)
+        fh.write("\n")
+
+
+def _twin_name(entry: MatrixEntry) -> str:
+    return entry.name.replace("_zero1", "")
+
+
+def verify_collectives(entries: Optional[Tuple[MatrixEntry, ...]] = None,
+                       update_golden: bool = False,
+                       golden_path: str = GOLDEN_PATH,
+                       tolerance: Optional[float] = None,
+                       progress=None) -> Tuple[List[Finding], dict]:
+    """Compile every supported matrix entry (shared cache with the
+    memory engine) and verify — or, with ``update_golden``, rewrite —
+    its golden collectives summary. Returns ``(findings, stats)``. The
+    semantic rules (stray-gather, axis-confinement, collective-free-
+    serve, zero1-exchange) run in BOTH modes: a regen can never bake a
+    violation into the golden file."""
+    import jax
+
+    entries = MATRIX if entries is None else entries
+    golden = load_golden(golden_path)
+    tol = (tolerance if tolerance is not None
+           else float(golden.get("tolerance", DEFAULT_TOLERANCE)))
+    on_cpu = jax.default_backend() == "cpu"
+    findings: List[Finding] = []
+    stats = {"compiled": 0, "compared": 0, "updated": [],
+             "skipped_devices": 0, "failed": 0}
+
+    if not on_cpu:
+        findings.append(Finding(
+            "golden-collectives-drift", "<golden-collectives>", 0,
+            f"golden collectives "
+            f"{'update' if update_golden else 'compare'} skipped on "
+            f"backend '{jax.default_backend()}' (summaries are defined "
+            f"over the CPU compile, like the jaxpr/memory goldens)",
+            "warning"))
+        return findings, stats
+
+    live = [e for e in entries
+            if e.expect_error is None and e.builder != "ctor-bn-axis"
+            and e.data_axis * e.model_axis <= len(jax.devices())]
+    stats["skipped_devices"] = sum(
+        1 for e in entries
+        if e.expect_error is None and e.builder != "ctor-bn-axis") \
+        - len(live)
+    summaries: Dict[str, dict] = {}
+    for entry in live:
+        if progress:
+            progress(entry.name)
+        try:
+            summaries[entry.name] = entry_comms_summary(entry)
+            stats["compiled"] += 1
+        except Exception as e:  # one broken entry must not cost the rest
+            stats["failed"] += 1
+            findings.append(Finding(
+                "collectives-budget",
+                f"<golden-collectives>/{entry.name}", 0,
+                f"supported combination FAILED to compile for its comms "
+                f"summary: {type(e).__name__}: {e}"))
+
+    for entry in live:
+        summary = summaries.get(entry.name)
+        if summary is None:
+            continue
+        # Semantic rules always run — including under --update-golden.
+        findings.extend(apply_rules(entry, summary,
+                                    twin=summaries.get(_twin_name(entry))))
+        if update_golden:
+            golden["entries"][entry.name] = summary
+            stats["updated"].append(entry.name)
+            continue
+        want = golden["entries"].get(entry.name)
+        if want is None:
+            findings.append(Finding(
+                "golden-collectives-drift",
+                f"<golden-collectives>/{entry.name}", 0,
+                "no golden collectives summary recorded for this entry "
+                "— run `python -m tpu_resnet check --update-golden` and "
+                "commit the regenerated "
+                "analysis/golden_collectives.json"))
+            continue
+        stats["compared"] += 1
+        findings.extend(_compare(entry.name, want, summary, tol))
+
+    if update_golden:
+        keep = {e.name for e in entries
+                if e.expect_error is None and e.builder != "ctor-bn-axis"}
+        golden["entries"] = {k: v for k, v in golden["entries"].items()
+                             if k in keep}
+        golden["format"] = GOLDEN_FORMAT
+        golden["tolerance"] = tol
+        golden["jax"] = jax.__version__
+        save_golden(golden, golden_path)
+    return findings, stats

@@ -133,6 +133,24 @@ REGISTRY_SCOPE_FILES = (
 # kernel-internal, never a run-level dispatch path.
 REGISTRY_SCOPE_PREFIXES = ("tpu_resnet/ops/",)
 
+# Modules allowed to construct shardings (NamedSharding) or pin layouts
+# (with_sharding_constraint). StatePartitioner (parallel/partition.py)
+# is the single OWNER of state-layout decisions — the collectives
+# engine's golden structure (analysis/collectives.py) is only a proof
+# if no other code path can inject a sharding behind its back — with
+# parallel/zero.py (the ZeRO update that applies the partitioner's
+# constraints), parallel/mesh.py (the canonical batch/replicated
+# sharding helpers everything else is supposed to call), train/step.py
+# and data/device_data.py (the registry-scoped program constructors
+# that pin their own argument layouts) as the documented call surface.
+SHARDING_SCOPE_FILES = (
+    "tpu_resnet/parallel/partition.py",
+    "tpu_resnet/parallel/zero.py",
+    "tpu_resnet/parallel/mesh.py",
+    "tpu_resnet/train/step.py",
+    "tpu_resnet/data/device_data.py",
+)
+
 # Host-isolated serving control plane: these modules must import with no
 # accelerator stack present (router on a broken-runtime host; batcher in
 # stdlib-only consumers). Direct module-scope imports only — unlike
@@ -824,6 +842,39 @@ def rule_registry_scope(tree: SourceTree) -> List[Finding]:
     return findings
 
 
+def rule_sharding_scope(tree: SourceTree) -> List[Finding]:
+    """NamedSharding/with_sharding_constraint only in partitioner-owned
+    modules."""
+    findings = []
+    target_names = ("jax.sharding.NamedSharding", "NamedSharding",
+                    "jax.lax.with_sharding_constraint",
+                    "with_sharding_constraint",
+                    "jax.experimental.pjit.with_sharding_constraint")
+    for rel, mod in tree.trees.items():
+        if not rel.startswith("tpu_resnet/") \
+                or rel in SHARDING_SCOPE_FILES:
+            continue
+        aliases = _alias_map(mod)
+        sites = []
+        for node in ast.walk(mod):
+            if isinstance(node, ast.Call) \
+                    and _resolved(node.func, aliases) in target_names:
+                sites.append(node.lineno)
+        for lineno in sorted(set(sites)):
+            findings.append(Finding(
+                "sharding-scope", rel, lineno,
+                "NamedSharding construction / with_sharding_constraint "
+                "outside the partitioner-owned modules: sharding "
+                "decisions belong to parallel.StatePartitioner and the "
+                "documented scope (SHARDING_SCOPE_FILES, "
+                "analysis/jaxlint.py) — a sharding injected from "
+                "anywhere else changes the compiled program's "
+                "collective structure behind the golden comms ledgers' "
+                "back (analysis/collectives.py), exactly the drift "
+                "check engine 5 exists to catch (docs/CHECKS.md)"))
+    return findings
+
+
 def rule_guard_parity(tree: SourceTree) -> List[Finding]:
     """build_model validation mirrored into public constructors (ADVICE r4)."""
     findings = []
@@ -887,6 +938,7 @@ RULES = {
     "signal-safety": rule_signal_safety,
     "host-isolation": rule_host_isolation,
     "registry-scope": rule_registry_scope,
+    "sharding-scope": rule_sharding_scope,
     "guard-parity": rule_guard_parity,
 }
 

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 from tpu_resnet.analysis.configmatrix import MATRIX, MatrixEntry
 from tpu_resnet.analysis.findings import Finding
+from tpu_resnet.obs.comms import hlo_text_of
 from tpu_resnet.obs.memory import BUDGET_COMPONENTS, budget_from_compiled
 
 GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden_memory.json")
@@ -52,7 +53,7 @@ DEFAULT_TOLERANCE = 0.10
 SLACK_BYTES = 65536
 
 
-def _compile_serve_budget(entry: MatrixEntry) -> dict:
+def _compile_serve_budget(entry: MatrixEntry) -> Tuple[dict, object]:
     """Serve rows compile the bucket inference program instead — the
     exact ``make_serve_infer`` jit the CheckpointBackend warms, over the
     exact argument avals it wraps (the int8 quantized tree for
@@ -91,16 +92,14 @@ def _compile_serve_budget(entry: MatrixEntry) -> dict:
                            "compiled program")
     budget["partition"] = entry.partition
     budget["weight_argument_bytes"] = quant_lib.tree_argument_bytes(var_sds)
-    return budget
+    return budget, compiled
 
 
-def compile_entry_budget(entry: MatrixEntry) -> dict:
+def _compile_train_budget(entry: MatrixEntry) -> Tuple[dict, object]:
     """Compile the entry's REAL train program on a concrete mesh (the
-    loop's own constructors, donation on) and return its memory budget.
-    Needs ``data_axis * model_axis`` local devices — the caller skips
-    otherwise. Serve rows dispatch to ``_compile_serve_budget``."""
-    if getattr(entry, "builder", "config") == "serve":
-        return _compile_serve_budget(entry)
+    loop's own constructors, donation on) and return ``(budget,
+    compiled)``. Needs ``data_axis * model_axis`` local devices — the
+    caller skips otherwise."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -170,7 +169,39 @@ def compile_entry_budget(entry: MatrixEntry) -> dict:
     # the XLA components (tests gate the zero1/replicated twin ratio).
     budget["partition"] = entry.partition
     budget.update(partitioner.state_argument_bytes(state_sds))
-    return budget
+    return budget, compiled
+
+
+# One compile per entry per process, shared by the memory and
+# collectives engines: `tpu-resnet check` runs both over the same
+# matrix, and the XLA compile (not the compare) is the whole cost.
+# Keyed by entry name; the budget is returned BY COPY so a caller (or a
+# golden write) can never mutate the cached truth.
+_ARTIFACTS: Dict[str, dict] = {}
+
+
+def entry_artifacts(entry: MatrixEntry) -> dict:
+    """Compile ``entry``'s real program once and return every artifact
+    the check engines extract from it: ``budget`` (the golden-memory
+    dict) and ``hlo_text`` (the post-SPMD-partitioner HLO the
+    collectives engine parses). Cached per entry name for the life of
+    the process."""
+    art = _ARTIFACTS.get(entry.name)
+    if art is None:
+        if getattr(entry, "builder", "config") == "serve":
+            budget, compiled = _compile_serve_budget(entry)
+        else:
+            budget, compiled = _compile_train_budget(entry)
+        art = {"budget": budget, "hlo_text": hlo_text_of(compiled)}
+        _ARTIFACTS[entry.name] = art
+    return {"budget": dict(art["budget"]), "hlo_text": art["hlo_text"]}
+
+
+def compile_entry_budget(entry: MatrixEntry) -> dict:
+    """The entry's memory budget (compiling at most once per process —
+    see :func:`entry_artifacts`). Serve rows compile the bucket
+    inference program, everything else the train step."""
+    return entry_artifacts(entry)["budget"]
 
 
 # The partitioner's analytic breakdown is deterministic arithmetic, so

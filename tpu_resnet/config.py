@@ -329,6 +329,14 @@ class TrainConfig:
     # failures degrade to absent, never kill training. Host-side only:
     # no compiled-program change, no new config-matrix rows.
     memory_ledger: bool = True
+    # Comms ledger (tpu_resnet/obs/comms.py): extract the compiled train
+    # step's collective-communication summary (op multiset, analytic
+    # bytes-on-wire per mesh axis, predicted time-on-wire from the
+    # per-chip ICI table) into <train_dir>/comms.json once at first
+    # dispatch, plus a predicted_comms_fraction gauge. Pays ONE extra
+    # XLA compile at startup, same contract as memory_ledger; degrades
+    # to absent, never kills training. Host-side only.
+    comms_ledger: bool = True
 
 
 @dataclasses.dataclass

@@ -33,6 +33,12 @@ package provides them as first-class artifacts of every run:
                 ``hbm_bytes_*`` gauges from ``device.memory_stats()``,
                 OOM forensics (``oom_report.json`` with a live-array
                 census) and the per-chip HBM capacity table.
+``comms``       the wire twin of ``mfu``/``memory``: compiled-program
+                collective summary (op multiset, analytic bytes-on-wire
+                per mesh axis from the post-partitioner HLO) persisted
+                to ``comms.json`` with the same program keys, predicted
+                time-on-wire from the per-chip ICI-bandwidth table and
+                a ``predicted_comms_fraction`` gauge.
 ``trace``       ``tpu_resnet trace-export`` — merge spans, breakdown
                 samples, data-engine counters, eval and serve events
                 into one Chrome-trace/Perfetto JSON correlated by the
@@ -44,7 +50,7 @@ the doctor's telemetry check — can use the scrape/parse helpers without
 pulling in a backend.
 """
 
-from tpu_resnet.obs import memory, mfu
+from tpu_resnet.obs import comms, memory, mfu
 from tpu_resnet.obs.breakdown import StepBreakdown
 from tpu_resnet.obs.manifest import (
     build_manifest,
@@ -71,6 +77,7 @@ __all__ = [
     "TelemetryRegistry",
     "TelemetryServer",
     "build_manifest",
+    "comms",
     "ensure_run_id",
     "histogram_quantile",
     "memory",

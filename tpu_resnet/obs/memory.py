@@ -195,6 +195,59 @@ class MemoryLedger:
         return ledger
 
 
+def lower_train_step(cfg, mesh, state, base_step,
+                     per_replica_bn: bool = False,
+                     stage_rows: int = 1, chunk_steps: int = 1,
+                     variant: str = "single-step",
+                     partitioner=None):
+    """Lower the train-step program the run's input edge actually
+    dispatches, over abstract avals — the ONE shared builder behind the
+    HBM (this module) and comms (``obs/comms.py``) accountants, so both
+    ledgers describe the same compiled program: ``stage_rows > 1``
+    builds the fused staged-chunk jit (``device_data.staged_chunk_jit``,
+    the loop's exact constructor — superbatch arguments and scan temps
+    included), else the plain sharded single step with the loop's real
+    donation and partitioner shardings. Returns ``(lowered, variant)``
+    where ``variant`` labels the program shape on ledger entries."""
+    import jax
+
+    from tpu_resnet import parallel
+    from tpu_resnet.train.step import shard_step
+
+    state_sharding = (partitioner.state_shardings(state)
+                     if partitioner is not None and partitioner.is_sharded
+                     else None)
+    size = cfg.data.resolved_image_size
+    gb = cfg.train.global_batch_size
+    img_dtype = "float32" if cfg.data.dataset == "imagenet" else "uint8"
+    if stage_rows > 1:
+        # The staged/double-buffered input edge's fused chunk program —
+        # built by the ONE canonical constructor the loop itself
+        # dispatches (device_data.staged_chunk_jit), so a ledger entry
+        # can never describe a different program than the run executes.
+        from tpu_resnet.data.device_data import staged_chunk_jit
+
+        jitted = staged_chunk_jit(base_step, mesh, max(1, chunk_steps),
+                                  per_replica_bn=per_replica_bn,
+                                  state_sharding=state_sharding)
+        gi = jax.ShapeDtypeStruct((stage_rows, gb, size, size, 3),
+                                  img_dtype)
+        gl = jax.ShapeDtypeStruct((stage_rows, gb), "int32")
+        off = jax.ShapeDtypeStruct((), "int32")
+        lowered = jitted.lower(state, gi, gl, off)
+        variant = (f"staged-chunk(steps={max(1, chunk_steps)}"
+                   f",stage={stage_rows})")
+    else:
+        bs = parallel.batch_sharding(mesh)
+        images = jax.ShapeDtypeStruct((gb, size, size, 3), img_dtype,
+                                      sharding=bs)
+        labels = jax.ShapeDtypeStruct((gb,), "int32", sharding=bs)
+        probe = shard_step(base_step, mesh, per_replica_bn=per_replica_bn,
+                           state_sharding=state_sharding)
+        lowered = probe.lower(state, images, labels)
+    return lowered, variant
+
+
 def account_train_step(cfg, mesh, state, base_step,
                        per_replica_bn: bool = False,
                        stage_rows: int = 1, chunk_steps: int = 1,
@@ -226,45 +279,15 @@ def account_train_step(cfg, mesh, state, base_step,
     (``params_argument_bytes`` / ``opt_state_argument_bytes`` /
     ``batch_stats_argument_bytes``), so the zero1 optimizer cut is a
     named number next to XLA's aggregate ``argument_bytes``."""
-    import jax
-
-    from tpu_resnet import parallel
     from tpu_resnet.obs.mfu import train_program_key
-    from tpu_resnet.train.step import shard_step
 
     ledger = ledger if ledger is not None else MemoryLedger()
     key = train_program_key(cfg, dict(mesh.shape))
-    state_sharding = (partitioner.state_shardings(state)
-                     if partitioner is not None and partitioner.is_sharded
-                     else None)
-    size = cfg.data.resolved_image_size
     gb = cfg.train.global_batch_size
-    img_dtype = "float32" if cfg.data.dataset == "imagenet" else "uint8"
-    if stage_rows > 1:
-        # The staged/double-buffered input edge's fused chunk program —
-        # built by the ONE canonical constructor the loop itself
-        # dispatches (device_data.staged_chunk_jit), so this ledger entry
-        # can never describe a different program than the run executes.
-        from tpu_resnet.data.device_data import staged_chunk_jit
-
-        jitted = staged_chunk_jit(base_step, mesh, max(1, chunk_steps),
-                                  per_replica_bn=per_replica_bn,
-                                  state_sharding=state_sharding)
-        gi = jax.ShapeDtypeStruct((stage_rows, gb, size, size, 3),
-                                  img_dtype)
-        gl = jax.ShapeDtypeStruct((stage_rows, gb), "int32")
-        off = jax.ShapeDtypeStruct((), "int32")
-        lowered = jitted.lower(state, gi, gl, off)
-        variant = (f"staged-chunk(steps={max(1, chunk_steps)}"
-                   f",stage={stage_rows})")
-    else:
-        bs = parallel.batch_sharding(mesh)
-        images = jax.ShapeDtypeStruct((gb, size, size, 3), img_dtype,
-                                      sharding=bs)
-        labels = jax.ShapeDtypeStruct((gb,), "int32", sharding=bs)
-        probe = shard_step(base_step, mesh, per_replica_bn=per_replica_bn,
-                           state_sharding=state_sharding)
-        lowered = probe.lower(state, images, labels)
+    lowered, variant = lower_train_step(
+        cfg, mesh, state, base_step, per_replica_bn=per_replica_bn,
+        stage_rows=stage_rows, chunk_steps=chunk_steps, variant=variant,
+        partitioner=partitioner)
     budget = budget_from_compiled(lowered.compile())
     kind = mesh.devices.flat[0].device_kind
     extra = {}

@@ -88,6 +88,13 @@ CORE_GAUGES = (
     ("hbm_bytes_limit", "Per-device memory capacity (backend-reported, "
                         "else the obs/memory HBM table)"),
     ("hbm_utilization", "hbm_bytes_in_use / hbm_bytes_limit (0..1)"),
+    # Comms accounting (tpu_resnet/obs/comms.py): predicted fraction of
+    # step time spent on the wire (ring-model bytes over the per-chip
+    # ICI bandwidth vs peak-compute time). Set once at first dispatch;
+    # stays 0 on chips the ICI table doesn't know (CPU).
+    ("predicted_comms_fraction",
+     "Predicted time-on-wire / (time-on-wire + peak-compute time) for "
+     "the compiled step (0..1; 0 where the ICI bandwidth is unknown)"),
     # Fault counters (tpu_resnet/resilience) — pre-declared so a scrape on
     # a healthy run reports explicit zeros, not absent series.
     ("fault_nan_rollbacks", "NaN/divergence rollbacks performed"),

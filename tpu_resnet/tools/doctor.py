@@ -311,6 +311,13 @@ def _check_static_analysis(matrix: bool = True, timeout: int = 900) -> dict:
                                                    {}).get("traced")
                 out["matrix_must_raise"] = payload.get(
                     "matrix", {}).get("must_raise")
+                # Engine 5 (analysis/collectives.py) rides the matrix:
+                # surface its compile/compare counts so DOCTOR_JSON
+                # says the collective structure was actually verified.
+                comms = payload.get("matrix", {}).get("collectives")
+                if comms:
+                    out["collectives_compiled"] = comms.get("compiled")
+                    out["collectives_compared"] = comms.get("compared")
             if errors:
                 e = errors[0]
                 out["first"] = (f"{e['path']}:{e['line']}: "

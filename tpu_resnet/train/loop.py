@@ -572,6 +572,48 @@ def train(cfg: RunConfig, mesh=None, metrics: Optional[MetricsWriter] = None,
                             "memory ledger failed (%s: %s) — memory.json "
                             "absent for this run", type(e).__name__, e)
                     breakdown.reset_interval()
+                if cfg.train.comms_ledger:
+                    # Collective summary of the compiled step
+                    # (obs/comms.py): op multiset + analytic bytes-on-
+                    # wire per mesh axis from the post-partitioner HLO,
+                    # plus predicted time-on-wire / comms-fraction from
+                    # the per-chip ICI table (feeding step_flops from
+                    # the mfu block above when it ran). Same contract
+                    # as the memory ledger: ONE extra XLA compile,
+                    # charged to the compile window, degrades to
+                    # absent.
+                    t_comm = time.time()
+                    try:
+                        staged_run = not resident and stage > 1
+                        entry = obs.comms.account_train_step(
+                            cfg, mesh, state, base_step,
+                            per_replica_bn=per_replica_bn,
+                            partitioner=partitioner,
+                            stage_rows=stage if staged_run else 1,
+                            chunk_steps=(max(1, cfg.train.steps_per_call)
+                                         if staged_run else 1),
+                            variant=("single-step (resident epoch-buffer "
+                                     "program approximated)" if resident
+                                     else "single-step"),
+                            flops_per_step=step_flops,
+                            train_dir=(cfg.train.train_dir
+                                       if parallel.is_primary() else None))
+                        frac = entry.get("predicted_comms_fraction")
+                        if frac is not None:
+                            telemetry.set("predicted_comms_fraction",
+                                          float(frac))
+                        spans.record(
+                            "comms_account", t_comm, time.time(),
+                            program_key=entry.get("program_key"),
+                            collective_count=entry.get("collective_count"),
+                            wire_bytes_per_device=entry.get(
+                                "wire_bytes_per_device"),
+                            predicted_comms_fraction=frac)
+                    except Exception as e:  # noqa: BLE001 - accounting
+                        log.warning(            # must never kill training
+                            "comms ledger failed (%s: %s) — comms.json "
+                            "absent for this run", type(e).__name__, e)
+                    breakdown.reset_interval()
                 meter.rate(step)
                 last_sync = step
                 last_log_step = step
