@@ -1,0 +1,31 @@
+"""Seeded host-isolation violation for the autopilot control plane.
+
+The real autopilot/controller.py is stdlib-only: the autoscaler must
+keep steering (and drain-scaling the fleet) on a host whose
+accelerator stack is the thing that is melting — a module-scope jax
+import would take the control loop down with the data plane. This
+fixture is the anti-pattern that must stay flagged.
+"""
+
+import threading
+import time
+
+import jax  # host-isolation: the autopilot must never import jax
+
+
+class EagerController:
+    """'Just read the device gauges directly' — couples every control
+    round to a working accelerator runtime."""
+
+    def __init__(self, poll_interval=1.0):
+        self._interval = poll_interval
+        self._stop = threading.Event()
+
+    def run_round(self):
+        free = jax.devices()[0].memory_stats()["bytes_available"]
+        return {"wall": time.time(), "hbm_free": free}
+
+    def loop(self):
+        while not self._stop.is_set():
+            self.run_round()
+            self._stop.wait(self._interval)

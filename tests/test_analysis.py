@@ -638,3 +638,23 @@ def test_scenario_fixture_flags_jax_import_and_real_package_is_clean():
               if f.startswith("tpu_resnet/scenario/")]
     assert len(scoped) == 6, scoped
     assert not _lint(REPO, select=["host-isolation"], files=scoped)
+
+
+def test_autopilot_fixture_flags_jax_import_and_real_package_is_clean():
+    """The autopilot control plane is host-isolated like the router and
+    the conductor: a module-scope jax import in tpu_resnet/autopilot/
+    must stay flagged, and every shipped autopilot module must keep
+    passing the same rule (the control loop has to keep steering while
+    the accelerator stack is the thing that is melting)."""
+    found = fixture_findings("autopilot_bad", "host-isolation")
+    assert len(found) == 1, found
+    assert "import of 'jax'" in found[0].message
+    assert found[0].path == "tpu_resnet/autopilot/controller.py"
+
+    from tpu_resnet.analysis.jaxlint import HOST_ONLY_FILES
+    from tpu_resnet.analysis.jaxlint import run_jaxlint as _lint
+
+    scoped = [f for f in HOST_ONLY_FILES
+              if f.startswith("tpu_resnet/autopilot/")]
+    assert len(scoped) == 6, scoped
+    assert not _lint(REPO, select=["host-isolation"], files=scoped)

@@ -1,0 +1,477 @@
+"""Fleet autopilot (tpu_resnet/autopilot/; docs/AUTOPILOT.md).
+
+Three layers, mirroring the subsystem's own split:
+
+- pure policy: the decide() table driven by literal SignalSnapshots —
+  hysteresis bands + streaks (no flap under an oscillating p99), both
+  cooldowns (scale-down anchored on the LAST actuation in either
+  direction), the colocation-admission backoff, min/max bounds and step
+  clamps, blind-round streak resets, the shed high-water mark, and the
+  bit-identical replay contract;
+- controller/actuator units: run_round() driven synchronously with an
+  injected collect_fn (scripted snapshots -> counters, integrators,
+  gauges, the status file, the decision ledger), the admission-denied
+  lifecycle through a fake actuator, spawn argv templating + the
+  supervise wrap, LIFO drain targeting, the capacity-lease file;
+- wiring: the conductor's ``autopilot`` process kind and the CLI's
+  usage guard. The full subprocess drills live in
+  scenarios/autoscale_*.json (doctor --autoscale-probe).
+"""
+
+import json
+import os
+import sys
+import types
+
+import pytest
+
+from tpu_resnet.autopilot import signals
+from tpu_resnet.autopilot.actuator import (Actuator, _Spawn,
+                                           read_capacity_lease)
+from tpu_resnet.autopilot.controller import (AUTOPILOT_STATUS_FILE,
+                                             AutopilotController)
+from tpu_resnet.autopilot.policy import (PolicyState, decide,
+                                         effective_slo,
+                                         note_admission_denied, replay)
+from tpu_resnet.autopilot.signals import SignalSnapshot
+from tpu_resnet.config import AutopilotConfig, load_config
+from tpu_resnet.obs.fleet import (read_fleet_snapshot,
+                                  write_fleet_snapshot,
+                                  FLEET_SNAPSHOT_FILE)
+from tpu_resnet.obs.server import parse_prometheus
+from tpu_resnet.obs.spans import load_spans
+from tpu_resnet.obs.trace import AUTOPILOT_EVENTS_FILE
+from tpu_resnet.resilience import exitcodes
+
+
+def _snap(wall, p99=None, healthy=1, pending=0, ok=True, shed=0.0,
+          queue=0.0, burn=None, slo=0.0, replicas=(), port=None):
+    return SignalSnapshot(
+        wall=float(wall), ok=ok, p99_ms=p99, slo_ms=float(slo),
+        replicas_healthy=healthy, replicas_pending=pending,
+        replicas_total=healthy, shed_total=float(shed),
+        queue_depth=float(queue), burn_fast=burn,
+        replicas=tuple(replicas), router_port=port)
+
+
+def _cfg(**kw):
+    base = dict(slo_ms=100.0, up_rounds=2, down_rounds=3,
+                min_replicas=1, max_replicas=4,
+                scale_up_cooldown_secs=10.0,
+                scale_down_cooldown_secs=60.0,
+                admission_backoff_secs=30.0)
+    base.update(kw)
+    return AutopilotConfig(**base)
+
+
+# ----------------------------------------------------------- pure policy
+def test_scale_up_needs_a_full_pressure_streak():
+    cfg = _cfg()
+    state = PolicyState()
+    d1, state = decide(_snap(0, p99=95), cfg, state)       # 95 > 90
+    assert d1.action == "hold" and d1.pressure == "up"
+    assert d1.reason == "pressure_up_building"
+    d2, state = decide(_snap(1, p99=95), cfg, state)
+    assert d2.action == "scale_up" and d2.reason == "p99"
+    assert (d2.current, d2.target, d2.step) == (1, 2, 1)
+    assert state.up_streak == 0 and state.last_up_wall == 1.0
+
+
+def test_hysteresis_corridor_and_streaks_never_flap():
+    """Two oscillation shapes that defeat single-threshold autoscalers:
+    a p99 bouncing inside the dead zone (between the bands), and one
+    alternating across the up band — neither may ever actuate."""
+    cfg = _cfg()
+    corridor, _ = replay([_snap(i, p99=60 if i % 2 else 85)
+                          for i in range(30)], cfg)
+    assert all(d.action == "hold" and d.pressure == "none"
+               for d in corridor)
+    alternating, _ = replay([_snap(i, p99=95 if i % 2 else 45, healthy=2)
+                             for i in range(30)], cfg)
+    assert all(d.action == "hold" for d in alternating)
+
+
+def test_scale_up_cooldown_holds_then_releases():
+    cfg = _cfg(up_rounds=1)
+    state = PolicyState()
+    d, state = decide(_snap(0, p99=150), cfg, state)
+    assert d.action == "scale_up"
+    d, state = decide(_snap(1, p99=150, healthy=2), cfg, state)
+    assert d.action == "hold" and d.reason == "up_cooldown"
+    d, state = decide(_snap(11, p99=150, healthy=2), cfg, state)
+    assert d.action == "scale_up" and state.last_up_wall == 11.0
+
+
+def test_scale_down_cooldown_anchors_on_last_actuation():
+    """Capacity just added must survive a full scale-down cooldown —
+    the anchor is max(last_up, last_down), not last_down alone."""
+    cfg = _cfg(up_rounds=1, down_rounds=1)
+    state = PolicyState()
+    d, state = decide(_snap(0, p99=150), cfg, state)
+    assert d.action == "scale_up"                # last_up_wall = 0
+    d, state = decide(_snap(5, p99=20, healthy=2), cfg, state)
+    assert d.action == "hold" and d.reason == "down_cooldown"
+    d, state = decide(_snap(61, p99=20, healthy=2), cfg, state)
+    assert d.action == "scale_down" and d.step == -1
+    assert state.last_down_wall == 61.0
+    d, state = decide(_snap(200, p99=20, healthy=1), cfg, state)
+    assert d.action == "hold" and d.reason == "at_min"
+
+
+def test_admission_backoff_delays_the_below_min_restore():
+    """Exit-3 colocation denial arms the backoff; the floor restore
+    waits it out, and pending spawns count toward current (no panic
+    double-spawn while one is already en route)."""
+    cfg = _cfg()
+    state = note_admission_denied(PolicyState(), wall=0.0, cfg=cfg)
+    assert state.denied_until == 30.0 and state.up_streak == 0
+    d, state = decide(_snap(5, healthy=0), cfg, state)
+    assert d.action == "hold" and d.reason == "admission_backoff"
+    d, state = decide(_snap(31, healthy=0), cfg, state)
+    assert d.action == "scale_up" and d.reason == "below_min"
+    # A spawn in flight IS capacity: current = healthy + pending.
+    d, state = decide(_snap(32, healthy=0, pending=1), cfg, state)
+    assert d.action == "hold" and d.current == 1
+
+
+def test_bounds_beat_everything_and_steps_clamp():
+    cfg = _cfg(min_replicas=2, max_replicas=3, up_rounds=1)
+    d, _ = decide(_snap(0, healthy=0), cfg, PolicyState())
+    assert (d.action, d.reason, d.step) == ("scale_up", "below_min", 1)
+    d, _ = decide(_snap(0, healthy=0),
+                  _cfg(min_replicas=2, max_replicas=3, max_step_up=5),
+                  PolicyState())
+    assert d.step == 2 and d.target == 2         # clamped to the floor
+    d, _ = decide(_snap(0, healthy=5), cfg, PolicyState())
+    assert (d.action, d.reason, d.step) == ("scale_down", "above_max", -1)
+    d, _ = decide(_snap(0, healthy=5),
+                  _cfg(min_replicas=2, max_replicas=3, max_step_down=5),
+                  PolicyState())
+    assert d.step == -2 and d.target == 3        # clamped to the ceiling
+    d, _ = decide(_snap(0, p99=150, healthy=3), cfg, PolicyState())
+    assert d.action == "hold" and d.reason == "at_max"
+
+
+def test_blind_rounds_hold_and_reset_streaks():
+    cfg = _cfg()
+    state = PolicyState()
+    _, state = decide(_snap(0, p99=150), cfg, state)
+    assert state.up_streak == 1
+    d, state = decide(_snap(1, ok=False), cfg, state)
+    assert d.action == "hold" and d.reason == "signals_unavailable"
+    assert d.current == -1
+    assert state.up_streak == 0 and state.down_streak == 0
+    d, state = decide(_snap(2, p99=150), cfg, state)
+    assert d.action == "hold"                    # streak restarts at 1
+    d, state = decide(_snap(3, p99=150), cfg, state)
+    assert d.action == "scale_up"
+
+
+def test_shed_high_water_mark_fires_on_raises_only():
+    """Cumulative router 429s: a RAISE since the last look is pressure,
+    a flat counter is not — the high-water mark survives in state."""
+    cfg = _cfg(slo_ms=0.0)                       # no latency signal
+    state = PolicyState()
+    d, state = decide(_snap(0, shed=5), cfg, state)
+    assert d.pressure == "up" and state.shed_seen == 5.0
+    d, state = decide(_snap(1, shed=5), cfg, state)
+    assert d.pressure == "none"
+    d, state = decide(_snap(2, shed=9), cfg, state)
+    assert d.pressure == "up" and state.shed_seen == 9.0
+
+
+def test_effective_slo_prefers_explicit_over_advertised():
+    assert effective_slo(_snap(0, slo=250), _cfg(slo_ms=0.0)) == 250.0
+    assert effective_slo(_snap(0, slo=250), _cfg(slo_ms=400.0)) == 400.0
+    assert effective_slo(_snap(0), _cfg(slo_ms=0.0)) == 0.0
+
+
+def test_replay_is_bit_identical_and_state_roundtrips():
+    cfg = _cfg(up_rounds=1, down_rounds=2, scale_up_cooldown_secs=0.0,
+               scale_down_cooldown_secs=5.0)
+    trace = [_snap(0, p99=150), _snap(1, ok=False),
+             _snap(2, p99=150, healthy=2, shed=3),
+             _snap(3, p99=20, healthy=2), _snap(4, p99=20, healthy=2),
+             _snap(10, p99=20, healthy=2), _snap(11, p99=20, healthy=2),
+             _snap(12, p99=60, healthy=1), _snap(13, healthy=0)]
+    first, end1 = replay(trace, cfg)
+    second, end2 = replay(trace, cfg)
+    assert first == second and end1 == end2      # frozen dataclasses
+    assert [d.action for d in first].count("scale_up") >= 2
+    assert "scale_down" in [d.action for d in first]
+    assert PolicyState.from_dict(end1.to_dict()) == end1
+
+
+# ------------------------------------------------------------ signals
+def test_signal_snapshot_json_roundtrip():
+    snap = _snap(7.5, p99=42.0, healthy=2, shed=3, port=8080,
+                 replicas=[{"name": "r0", "state": "closed",
+                            "draining": False, "pending": False,
+                            "inflight": 1, "queue_depth": 0}])
+    snap = SignalSnapshot(**{**snap.__dict__,
+                             "errors": ("router /info: timeout",),
+                             "hbm": (("r0", {"hbm_bytes_in_use": 5.0,
+                                             "hbm_bytes_limit": 10.0}),)})
+    wire = json.loads(json.dumps(snap.to_dict()))
+    back = SignalSnapshot.from_dict(wire)
+    # from_dict keeps replicas as dicts inside the tuple — compare field
+    # by field through to_dict, the serialization contract itself.
+    assert back.to_dict() == snap.to_dict()
+    assert back.wall == 7.5 and back.errors == snap.errors
+
+
+def test_collect_on_empty_dir_is_an_explicit_blind_round(tmp_path):
+    snap = signals.collect(str(tmp_path))
+    assert not snap.ok
+    assert "route.json" in snap.errors[0]
+
+
+def test_fleet_snapshot_digest_gates_reads(tmp_path):
+    d = str(tmp_path)
+    assert read_fleet_snapshot(d) is None
+    write_fleet_snapshot(d, {"round": 3, "fleet": {"p99_ms": 12.5}})
+    body = read_fleet_snapshot(d)
+    assert body["round"] == 3 and body["fleet"]["p99_ms"] == 12.5
+    # A hand edit keeps the old digest: the read must refuse it.
+    path = os.path.join(d, FLEET_SNAPSHOT_FILE)
+    with open(path) as f:
+        tampered = json.load(f)
+    tampered["round"] = 99
+    with open(path, "w") as f:
+        json.dump(tampered, f)
+    assert read_fleet_snapshot(d) is None
+
+
+def test_loadgen_diurnal_schedule_is_bounded_and_deterministic():
+    from tools.loadgen import SCENARIOS, qps_factor
+
+    assert "diurnal" in SCENARIOS
+    vals = [qps_factor("diurnal", i / 200.0) for i in range(201)]
+    assert all(0.05 <= v <= 1.6 + 1e-9 for v in vals)
+    assert vals == [qps_factor("diurnal", i / 200.0) for i in range(201)]
+    assert qps_factor("diurnal", 0.0) == pytest.approx(0.3)
+    assert max(vals) > 1.1 and min(vals) < 0.25   # real up AND down swings
+
+
+# ----------------------------------------------------------- controller
+def _ctl_cfg(tmp_path, **auto):
+    cfg = load_config()
+    cfg.autopilot.discover_dir = str(tmp_path)
+    cfg.autopilot.slo_ms = 100.0
+    cfg.autopilot.up_rounds = 2
+    cfg.autopilot.min_replicas = 1
+    cfg.autopilot.max_replicas = 4
+    for k, v in auto.items():
+        setattr(cfg.autopilot, k, v)
+    return cfg
+
+
+def test_controller_round_counters_gauges_status_and_ledger(tmp_path):
+    """Three scripted rounds (hold -> scale_up -> blind) through the
+    real controller in observe-only mode: the counters, the integrators
+    (snapshot time, not wall time), the gauges, autopilot_status.json
+    and the decision ledger all describe the same rounds."""
+    trace = [_snap(0, p99=150), _snap(1, p99=150), _snap(2, ok=False)]
+    it = iter(trace)
+    ctl = AutopilotController(_ctl_cfg(tmp_path),
+                              collect_fn=lambda: next(it))
+    try:
+        assert ctl.run_round().action == "hold"
+        assert ctl.run_round().action == "scale_up"
+        assert ctl.run_round().reason == "signals_unavailable"
+        status = ctl.status()
+        c = status["counters"]
+        assert c["rounds"] == 3 and c["scale_ups"] == 1
+        assert c["holds"] == 2 and c["signal_errors"] == 1
+        assert c["spawns"] == 0                  # observe-only
+        assert status["target"] == 2
+        # Integrators ride snapshot walls: exactly one 1s interval, all
+        # of it above the SLO.
+        assert status["replica_seconds"] == 1.0
+        assert status["slo_violation_seconds"] == 1.0
+        gauges = parse_prometheus(ctl.registry.render())
+        assert gauges["tpu_resnet_autopilot_rounds_total"] == 3.0
+        assert gauges["tpu_resnet_autopilot_target_replicas"] == 2.0
+        assert gauges["tpu_resnet_autopilot_scale_ups_total"] == 1.0
+        with open(os.path.join(str(tmp_path),
+                               AUTOPILOT_STATUS_FILE)) as f:
+            on_disk = json.load(f)
+        assert on_disk["counters"] == c
+        assert on_disk["decision"]["reason"] == "signals_unavailable"
+    finally:
+        ctl.close()
+    spans = load_spans(os.path.join(str(tmp_path),
+                                    AUTOPILOT_EVENTS_FILE))
+    decisions = [s for s in spans if s["span"] == "autopilot_decision"]
+    assert [s["action"] for s in decisions] == ["hold", "scale_up",
+                                                "hold"]
+    assert decisions[0]["reason"] == "pressure_up_building"
+
+
+class _FakeActuator:
+    """Scripted lifecycle events + recorded spawns; observe_only False
+    so the controller exercises the real actuation branch."""
+
+    observe_only = False
+    lease_granted = False
+
+    def __init__(self, events):
+        self.events = list(events)
+        self.spawned = []
+
+    def pending_count(self):
+        return 0
+
+    def poll(self, snapshot):
+        return self.events.pop(0) if self.events else []
+
+    def spawn_replica(self):
+        self.spawned.append(f"ap{len(self.spawned)}")
+        return {"name": self.spawned[-1], "pid": 4000 + len(self.spawned)}
+
+    def close(self):
+        pass
+
+
+def test_controller_admission_denied_then_backoff_then_spawn(tmp_path):
+    """The full exit-3 story: a denial event arms the policy backoff
+    (the below-min restore HOLDS), and once the backoff lapses the
+    floor is restored through a real spawn_replica() call."""
+    denial = [{"kind": "admission_denied", "name": "ap0", "rc": 3}]
+    fake = _FakeActuator([denial, []])
+    trace = [_snap(0, healthy=0), _snap(40, healthy=0)]
+    it = iter(trace)
+    ctl = AutopilotController(_ctl_cfg(tmp_path),
+                              collect_fn=lambda: next(it),
+                              actuator=fake)
+    try:
+        d1 = ctl.run_round()
+        assert d1.action == "hold" and d1.reason == "admission_backoff"
+        d2 = ctl.run_round()
+        assert d2.action == "scale_up" and d2.reason == "below_min"
+        assert fake.spawned == ["ap0"]
+        c = ctl.status()["counters"]
+        assert c["admission_denied"] == 1 and c["spawns"] == 1
+    finally:
+        ctl.close()
+    kinds = [s["span"] for s in load_spans(
+        os.path.join(str(tmp_path), AUTOPILOT_EVENTS_FILE))]
+    assert "autopilot_admission_denied" in kinds
+    assert "autopilot_spawn" in kinds
+
+
+def test_controller_admitted_spawn_is_not_also_counted_pending(tmp_path):
+    """The round that first sees a spawn healthy in the router must not
+    ALSO count it as pending: current = healthy + pending would read
+    3 with max_replicas=2 and the above_max bound (which rightly skips
+    cooldowns) would drain the replica the moment it was admitted — an
+    admit/drain flap loop. poll() runs before replicas_pending is
+    stamped, so the spawn graduates within the round."""
+    cfg = _ctl_cfg(tmp_path, max_replicas=2)
+    ctl = AutopilotController(
+        cfg, collect_fn=lambda: _snap(
+            10.0, p99=300, healthy=2,
+            replicas=({"name": "r0", "state": "closed",
+                       "draining": False, "pending": False},
+                      {"name": "ap0", "state": "closed",
+                       "draining": False, "pending": False})))
+    try:
+        # One in-flight spawn, launched earlier, now healthy above.
+        ctl.actuator._spawns.append(_Spawn(
+            "ap0", types.SimpleNamespace(
+                poll=lambda: None, terminate=lambda: None,
+                kill=lambda: None, wait=lambda timeout=None: 0),
+            8.0, ""))
+        assert ctl.actuator.pending_count() == 1
+        d = ctl.run_round()
+        assert d.action == "hold"             # NOT above_max scale_down
+        assert d.current == 2                 # not 3
+        assert ctl.actuator.pending_count() == 0
+        assert ctl.status()["scale_up_latency_ms"] == 2000.0
+    finally:
+        ctl.close()
+    spans = load_spans(os.path.join(str(tmp_path),
+                                    AUTOPILOT_EVENTS_FILE))
+    ready = [s for s in spans if s["span"] == "autopilot_replica_ready"]
+    assert len(ready) == 1 and ready[0]["name"] == "ap0"
+    decision = [s for s in spans
+                if s["span"] == "autopilot_decision"][-1]
+    assert decision["replicas_pending"] == 0
+
+
+# ------------------------------------------------------------- actuator
+def test_actuator_builds_supervised_argv_from_template(tmp_path):
+    cfg = load_config()
+    cfg.autopilot.spawn_cmd = ("{python} -m tpu_resnet serve "
+                               "serve.replica_name={name} data.seed={i}")
+    act = Actuator(cfg, str(tmp_path), spans=None)
+    argv = act._build_argv("ap0", 0)
+    assert argv[0] == sys.executable
+    assert argv[1].endswith(os.path.join("tools", "supervise.py"))
+    stop = argv.index("--stop-codes")
+    assert argv[stop + 1] == str(exitcodes.NO_CAPACITY)
+    tail = argv[argv.index("--") + 1:]
+    assert tail == [sys.executable, "-m", "tpu_resnet", "serve",
+                    "serve.replica_name=ap0", "data.seed=0"]
+    cfg.autopilot.spawn_supervised = False
+    assert act._build_argv("ap7", 7) == [
+        sys.executable, "-m", "tpu_resnet", "serve",
+        "serve.replica_name=ap7", "data.seed=7"]
+
+
+def test_actuator_drain_target_is_lifo_owned_first(tmp_path):
+    act = Actuator(load_config(), str(tmp_path), spans=None)
+
+    def rec(name):
+        return {"name": name, "state": "closed", "draining": False,
+                "pending": False}
+
+    snap = types.SimpleNamespace(
+        replicas=(rec("r0"), rec("ap0"), rec("ap1")))
+    # No owned spawns yet: fall back to the lexicographically-last
+    # healthy external replica.
+    assert act.pick_drain_target(snap) == "r0"
+    for name in ("ap0", "ap1"):
+        act._spawns.append(_Spawn(name, types.SimpleNamespace(), 0.0, ""))
+    assert act.pick_drain_target(snap) == "ap1"   # newest owned first
+    act._spawns[-1].done = True
+    assert act.pick_drain_target(snap) == "ap0"
+    empty = types.SimpleNamespace(replicas=())
+    assert act.pick_drain_target(empty) is None
+
+
+def test_capacity_lease_grant_and_revoke_roundtrip(tmp_path):
+    d = str(tmp_path)
+    act = Actuator(load_config(), d, spans=None, clock=lambda: 123.0)
+    assert read_capacity_lease(d) is None
+    act.grant_lease(2)
+    lease = read_capacity_lease(d)
+    assert lease["state"] == "granted" and lease["holder"] == "trainer"
+    assert lease["freed_replicas"] == 2 and lease["wall"] == 123.0
+    assert act.lease_granted
+    act.revoke_lease()
+    assert read_capacity_lease(d)["state"] == "revoked"
+    assert not act.lease_granted
+
+
+# --------------------------------------------------------------- wiring
+def test_conductor_runs_autopilot_as_a_module_kind():
+    from tpu_resnet.scenario.conductor import _build_argv
+    from tpu_resnet.scenario.spec import PROC_KINDS
+
+    assert "autopilot" in PROC_KINDS
+    argv = _build_argv({"kind": "autopilot", "preset": "smoke",
+                        "overrides": {"autopilot.min_replicas": 1}},
+                       root="/root/repo")
+    assert argv[:4] == [sys.executable, "-m", "tpu_resnet", "autopilot"]
+    assert argv[4:6] == ["--preset", "smoke"]
+    assert "autopilot.min_replicas=1" in argv
+
+
+def test_cli_refuses_to_run_without_a_fleet_directory():
+    from tpu_resnet.autopilot.cli import autopilot
+
+    cfg = load_config()
+    cfg.autopilot.discover_dir = ""
+    cfg.train.train_dir = ""
+    assert autopilot(cfg) == exitcodes.USAGE_ERROR

@@ -236,19 +236,21 @@ def test_burn_alert_fires_and_clears_across_rounds(tmp_path):
     agg = FleetAggregator(cfg, clock=lambda: clock["t"])
     try:
         empty = {"buckets": [], "sum": 0.0, "count": 0}
-        assert agg._note_round(clock["t"], empty)[2:] == (False, False)
+        assert agg._note_round(clock["t"], empty)[2:4] == (False, False)
         clock["t"] += 5
         hot = _hist_of([400.0] * 100)           # all blown vs 10ms SLO
-        fast, slow, fired, cleared = agg._note_round(clock["t"], hot)
-        assert fired and not cleared
+        fast, slow, fired, cleared, active, _ = \
+            agg._note_round(clock["t"], hot)
+        assert fired and active and not cleared
         assert fast == pytest.approx(10.0) and slow == pytest.approx(10.0)
         # still hot -> no re-fire while the alert holds
         clock["t"] += 5
-        assert agg._note_round(clock["t"], hot)[2:] == (False, False)
+        assert agg._note_round(clock["t"], hot)[2:4] == (False, False)
         # a quiet hour: windows see no new requests -> burn 0 -> clear
         clock["t"] += 3600
-        fast, slow, fired, cleared = agg._note_round(clock["t"], hot)
-        assert cleared and not fired and fast == 0.0
+        fast, slow, fired, cleared, active, _ = \
+            agg._note_round(clock["t"], hot)
+        assert cleared and not fired and not active and fast == 0.0
         snap = agg.snapshot()
         assert snap["alerts"] == 1 and snap["alert_active"] is False
         assert snap["rounds"] == 4

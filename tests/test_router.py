@@ -847,3 +847,107 @@ def test_trace_id_echoed_on_shed_and_error_paths(fleet):
     assert len(spans) == 1          # always-keep: shed
     assert spans[0]["sampled"] == "shed" and spans[0]["status"] == 429
     assert spans[0]["decision"] == "shed"
+
+
+# ------------------------------------------- watch-discovery probation
+
+def test_watch_discovery_probation_admits_on_first_probe(tmp_path):
+    """Deterministic probation walk (no probe thread): a replica that
+    appears AFTER router boot under --watch-discovery enters rotation
+    pending (excluded), and the first successful probe admits it with a
+    replica_admitted span. Boot-time replicas are never on probation."""
+    d = str(tmp_path)
+    from tpu_resnet.obs.manifest import ensure_run_id
+    from tpu_resnet.obs.spans import load_spans
+    from tpu_resnet.obs.trace import ROUTE_EVENTS_FILE
+
+    ensure_run_id(d)
+    s0 = _mk_replica(d, "r0")
+    router = _mk_router(d, watch_discovery=True)  # NOT started
+    try:
+        r0 = next(r for r in router.replicas() if r.name == "r0")
+        assert not r0.pending        # boot scan: admitted on faith
+        s1 = _mk_replica(d, "r1")
+        router.refresh_discovery()
+        r1 = next(r for r in router.replicas() if r.name == "r1")
+        assert r1.pending and not r1.healthy
+        assert r1.describe()["pending"] is True
+        router.probe_once()          # first healthy probe -> admitted
+        assert not r1.pending and r1.healthy
+        router.spans.close()
+        kinds = [s["span"] for s in
+                 load_spans(os.path.join(d, ROUTE_EVENTS_FILE))]
+        assert "replica_admitted" in kinds
+    finally:
+        router.close()
+        for srv in (s0, s1):
+            srv.batcher.drain(2.0)
+            srv.close()
+
+
+def test_watch_discovery_replica_joins_mid_traffic(tmp_path):
+    """End-to-end: traffic flows against one replica, a second joins
+    mid-stream and is admitted on merit by the live probe loop; the
+    fleet answers 200 throughout and /info reports both healthy."""
+    d = str(tmp_path)
+    from tpu_resnet.obs.manifest import ensure_run_id
+
+    ensure_run_id(d)
+    s0 = _mk_replica(d, "r0")
+    router = _mk_router(d, watch_discovery=True).start()
+    s1 = None
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(r.healthy and r.image_shape
+                   for r in router.replicas()):
+                break
+            time.sleep(0.05)
+        for i in range(4):
+            code, out, _ = _post(router.port, _img(i).tobytes(),
+                                 "1,8,8,3")
+            assert code == 200
+        s1 = _mk_replica(d, "r1")       # joins AFTER router boot
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            live = [r for r in router.replicas()
+                    if r.healthy and r.image_shape]
+            if len(live) == 2:
+                break
+            time.sleep(0.05)
+        assert len(live) == 2, [r.describe() for r in router.replicas()]
+        for i in range(8):
+            code, out, _ = _post(router.port, _img(i).tobytes(),
+                                 "1,8,8,3")
+            assert code == 200
+        code, info = _get(router.port, "/info")
+        by_name = {r["name"]: r for r in info["replicas"]}
+        assert by_name["r1"]["pending"] is False
+        assert by_name["r1"]["state"] == "closed"
+    finally:
+        router.close()
+        for srv in (s0,) + ((s1,) if s1 is not None else ()):
+            srv.batcher.drain(2.0)
+            srv.close()
+
+
+def test_without_watch_discovery_postboot_join_is_not_probationed(tmp_path):
+    """Default-off regression guard: with watch_discovery false a
+    post-boot discovery arrival is upserted exactly as before — never
+    pending."""
+    d = str(tmp_path)
+    from tpu_resnet.obs.manifest import ensure_run_id
+
+    ensure_run_id(d)
+    s0 = _mk_replica(d, "r0")
+    router = _mk_router(d)               # watch_discovery defaults off
+    try:
+        s1 = _mk_replica(d, "r1")
+        router.refresh_discovery()
+        r1 = next(r for r in router.replicas() if r.name == "r1")
+        assert not r1.pending
+    finally:
+        router.close()
+        for srv in (s0, s1):
+            srv.batcher.drain(2.0)
+            srv.close()

@@ -26,6 +26,11 @@ Scenarios (``--scenario``; each emits RESULT_JSON that ``perfwatch
                   0.25x and 2x ``--qps`` in quarter-duration phases.
 ``ramp``          diurnal ramp: offered qps follows a half-sine from
                   0.2x up through 1x and back down over the run.
+``diurnal``       sine-on-a-ramp: a 0.3x->1x rising baseline carrying
+                  two full day/night sine cycles — deterministic and
+                  resumable (pure function of run fraction), the
+                  arrival schedule the autoscale_diurnal scenario
+                  drives the autopilot with (docs/AUTOPILOT.md).
 ``slow_client``   2 byte-trickling clients (raw sockets, body sent in
                   delayed chunks) run BESIDE the normal fleet traffic;
                   their tally is reported separately — the check is that
@@ -93,8 +98,8 @@ from bench import _print_line  # noqa: E402  (hardened single-write emit)
 from tpu_resnet.obs.server import parse_prometheus  # noqa: E402
 from tpu_resnet.serve.batcher import percentile  # noqa: E402
 
-SCENARIOS = ("steady", "burst", "ramp", "slow_client", "mixed_lane",
-             "replica_kill", "rolling_drain")
+SCENARIOS = ("steady", "burst", "ramp", "diurnal", "slow_client",
+             "mixed_lane", "replica_kill", "rolling_drain")
 
 
 def _get_json(url: str, timeout: float = 10.0) -> dict:
@@ -120,6 +125,16 @@ def qps_factor(scenario: str, frac: float) -> float:
     if scenario == "ramp":
         # Diurnal half-sine: trough -> peak -> trough.
         return 0.2 + 0.8 * math.sin(math.pi * frac)
+    if scenario == "diurnal":
+        # Sine-on-a-ramp: a rising baseline (the "growing user base")
+        # carrying two full day/night cycles — the autoscale_diurnal
+        # drill wants repeated up AND down swings with a drifting mean,
+        # so an autopilot that only handles one burst shape flunks.
+        # Pure function of frac: the schedule is deterministic and
+        # resumable (restart at frac f, get the same curve).
+        ramp = 0.3 + 0.7 * frac
+        wave = 1.0 + 0.6 * math.sin(2.0 * math.pi * 2.0 * frac)
+        return max(0.05, ramp * wave)
     return 1.0
 
 
@@ -349,7 +364,7 @@ def run_load(url: str, clients: int = 8, duration: float = 10.0,
     if scenario not in SCENARIOS:
         raise ValueError(f"unknown scenario {scenario!r}; have "
                          f"{SCENARIOS}")
-    if scenario in ("burst", "ramp"):
+    if scenario in ("burst", "ramp", "diurnal"):
         mode = "open"  # a shaped offered load needs open-loop pacing
     if scenario in ("replica_kill", "rolling_drain") and not fleet_dir:
         raise ValueError(f"scenario {scenario} needs --fleet-dir (the "

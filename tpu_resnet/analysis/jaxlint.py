@@ -172,7 +172,17 @@ HOST_ONLY_FILES = ("tpu_resnet/serve/router.py",
                    "tpu_resnet/scenario/catalog.py",
                    "tpu_resnet/scenario/cli.py",
                    "tpu_resnet/scenario/conductor.py",
-                   "tpu_resnet/scenario/spec.py")
+                   "tpu_resnet/scenario/spec.py",
+                   # The autoscaling control plane scales the fleet
+                   # PRECISELY when the data plane is melting; a jax
+                   # import here would tie the controller's fate to the
+                   # stack it supervises.
+                   "tpu_resnet/autopilot/__init__.py",
+                   "tpu_resnet/autopilot/signals.py",
+                   "tpu_resnet/autopilot/policy.py",
+                   "tpu_resnet/autopilot/actuator.py",
+                   "tpu_resnet/autopilot/controller.py",
+                   "tpu_resnet/autopilot/cli.py")
 
 HOST_SYNC_EXACT = {
     "print": "host I/O",

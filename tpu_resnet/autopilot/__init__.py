@@ -1,0 +1,33 @@
+"""Fleet autopilot — the traffic-driven autoscaling control plane.
+
+Closes the loop the ROADMAP calls "no idle chips, no blown SLO": the
+router's SLO/queue/shed gauges (PR 11) and fleetmon's burn rates
+(PR 14) become *inputs*, the supervise/discovery spawn path with PR 10
+colocation admission and the router's rolling-drain contract become
+*outputs*, and in between sits a deterministic policy whose every
+decision is replayable from its ledger.
+
+Layout (the resolve/act split of resilience/elastic.py):
+
+``signals.py``     one frozen SignalSnapshot per round (router /info +
+                   fleetmon's digest-verified fleet_snapshot.json).
+``policy.py``      pure ``decide(snapshot, config, state)`` —
+                   hysteresis bands, streaks, cooldowns, min/max
+                   bounds, step limits, admission-denied backoff.
+``actuator.py``    every side effect: supervised replica spawns,
+                   router /admin/drain, the capacity lease handed to a
+                   colocated trainer.
+``controller.py``  the loop thread + ledger/gauges/status artifacts.
+``cli.py``         ``python -m tpu_resnet autopilot``.
+
+Every module here is in the jaxlint host-isolation scope: the control
+plane must keep steering while the accelerator stack is the thing
+that is melting.
+"""
+
+from tpu_resnet.autopilot.policy import (Decision, PolicyState, decide,
+                                         note_admission_denied, replay)
+from tpu_resnet.autopilot.signals import SignalSnapshot, collect
+
+__all__ = ["Decision", "PolicyState", "SignalSnapshot", "collect",
+           "decide", "note_admission_denied", "replay"]

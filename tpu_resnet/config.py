@@ -545,6 +545,14 @@ class RouteConfig:
     # wait for the drained replica's in-flight requests, then SIGTERM
     # (pid from its discovery record) and wait for the PR 2/5 drain.
     drain_timeout_secs: float = 30.0
+    # Merit-gated dynamic membership (route --watch-discovery): a
+    # replica whose discovery record APPEARS after router boot enters
+    # rotation only after its first successful health probe (a
+    # "pending" probation), instead of the default blind admission with
+    # a fresh closed breaker. The autoscaler path relies on this: a
+    # freshly spawned replica must not receive traffic before it has
+    # proven /healthz once.
+    watch_discovery: bool = False
 
 
 @dataclasses.dataclass
@@ -587,6 +595,88 @@ class FleetConfig:
 
 
 @dataclasses.dataclass
+class AutopilotConfig:
+    """Traffic-driven autoscaling control plane (tpu_resnet/autopilot/;
+    docs/AUTOPILOT.md). ``tpu_resnet autopilot`` is a jax-free control
+    process that scrapes the router + fleetmon signal plane, feeds a
+    deterministic target-replica policy (hysteresis bands, cooldowns,
+    min/max bounds, step limits — a pure function of one signal
+    snapshot, so recorded traces replay bit-identically), and actuates
+    through the existing contracts: scale-up spawns a replica via the
+    supervise/discovery path (colocation-admission exit 3 is a policy
+    input, not a crash), scale-down drains via the router's
+    /admin/drain rolling contract."""
+
+    # Autopilot's own telemetry port: 0 = OS-assigned ephemeral
+    # (recorded in <discover_dir>/autopilot.json), >0 fixed,
+    # <0 disabled.
+    port: int = 0
+    host: str = "0.0.0.0"
+    # Directory holding the fleet's discovery files (route.json,
+    # fleetmon.json, serve-<name>.json) — also where the decision
+    # ledger autopilot_events.jsonl and autopilot_status.json land.
+    # "" = train.train_dir (the colocated default).
+    discover_dir: str = ""
+    # Control-loop cadence and per-scrape HTTP timeout.
+    poll_interval_secs: float = 1.0
+    scrape_timeout_secs: float = 2.0
+    # Replica-count bounds the policy can never leave.
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Latency SLO the policy scales against, ms. 0 = adopt the router's
+    # advertised route.slo_ms from its /info (the usual colocated case).
+    slo_ms: float = 0.0
+    # Hysteresis bands as fractions of the SLO: p99 above
+    # slo*up_band is scale-up pressure, p99 below slo*down_band is
+    # scale-down pressure, and the corridor between them is a hold — a
+    # p99 oscillating around one threshold can never flap the fleet.
+    up_band: float = 0.9
+    down_band: float = 0.5
+    # Consecutive pressured rounds required before acting (the second
+    # anti-flap stage: one noisy scrape is never a decision).
+    up_rounds: int = 2
+    down_rounds: int = 5
+    # Non-latency scale-up pressure: total queued requests per healthy
+    # replica (router /info), and the fleetmon fast-window burn rate.
+    queue_high: float = 8.0
+    burn_high: float = 6.0
+    # Cooldowns (seconds of snapshot time) after an actuation before
+    # the same direction may fire again. Scale-down is deliberately the
+    # longer one: adding capacity is cheap, thrashing drains is not.
+    scale_up_cooldown_secs: float = 10.0
+    scale_down_cooldown_secs: float = 60.0
+    # Per-decision step limits (replicas added/removed at once).
+    max_step_up: int = 1
+    max_step_down: int = 1
+    # After a spawn exits with the colocation-admission NO_CAPACITY
+    # code (3), hold all scale-ups this long — this host said no, and
+    # asking again immediately would just be denied again.
+    admission_backoff_secs: float = 30.0
+    # Replica spawn command template, shlex-split; "" = observe-only
+    # mode (decisions are ledgered and gauged but nothing is spawned or
+    # drained). Placeholders: {python} -> sys.executable, {name} -> the
+    # replica name the actuator minted (serve.replica_name={name} makes
+    # the new replica discoverable), {i} -> the spawn ordinal.
+    spawn_cmd: str = ""
+    # Wrap spawns in tools/supervise.py --stop-codes 3 so crashes
+    # restart with decorrelated-jitter backoff while the admission
+    # verdict stays terminal (and observable as the wrapper's exit 3).
+    spawn_supervised: bool = True
+    # Names minted for autopilot-spawned replicas: <prefix><ordinal>.
+    replica_prefix: str = "ap"
+    # Budget (seconds) for spawn -> healthy-in-router; a spawn that
+    # blows it is abandoned (process terminated, slot released) and
+    # counted as a spawn failure. This is the advertised scale-up
+    # latency the autoscale scenarios gate.
+    ready_timeout_secs: float = 120.0
+    # Capacity handoff: on scale-down write <dir>/capacity_lease.json
+    # granting the freed capacity to a colocated trainer; the next
+    # scale-up revokes the lease BEFORE spawning (docs/AUTOPILOT.md
+    # "Capacity handoff").
+    capacity_lease: bool = True
+
+
+@dataclasses.dataclass
 class ProgramsConfig:
     """Compiled-program registry (tpu_resnet/programs/registry.py;
     docs/PERF.md "Cold start"). One owner for the canonical program-key
@@ -623,6 +713,8 @@ class RunConfig:
     serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
     route: RouteConfig = dataclasses.field(default_factory=RouteConfig)
     fleet: FleetConfig = dataclasses.field(default_factory=FleetConfig)
+    autopilot: AutopilotConfig = dataclasses.field(
+        default_factory=AutopilotConfig)
     programs: ProgramsConfig = dataclasses.field(
         default_factory=ProgramsConfig)
 

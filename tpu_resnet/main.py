@@ -64,6 +64,13 @@ def main(argv=None):
                      "timeseries, merge per-replica latency histograms "
                      "into true fleet p50/p95/p99, page on SLO "
                      "error-budget burn (docs/OBSERVABILITY.md)"),
+        ("autopilot", "traffic-driven autoscaling control plane: scrape "
+                      "the router + fleetmon signals, run the "
+                      "deterministic target-replica policy (hysteresis "
+                      "bands, cooldowns, min/max), spawn replicas via "
+                      "supervise/discovery gated by colocation "
+                      "admission, drain via the router's rolling "
+                      "contract (docs/AUTOPILOT.md)"),
         ("inspect", "list arrays in a checkpoint (tf_saver equivalent)"),
         ("plot", "render precision/loss/throughput curves from metrics.jsonl"),
         ("trace-export", "merge a run's spans/metrics/eval/serve events "
@@ -95,6 +102,14 @@ def main(argv=None):
                            help="with --drain: the running router's "
                                 "base url (default: discovered from "
                                 "route.json in route.discover_dir)")
+            p.add_argument("--watch-discovery", action="store_true",
+                           help="merit-gated dynamic membership: a "
+                                "replica whose discovery record appears "
+                                "after boot enters rotation only after "
+                                "its first successful health probe "
+                                "(shorthand for "
+                                "route.watch_discovery=true; the "
+                                "autopilot's spawn path relies on it)")
         if name == "info":
             p.add_argument("--layers", action="store_true",
                            help="per-parameter table (tfprof-style dump)")
@@ -217,6 +232,19 @@ def main(argv=None):
                                 "merged p99 > healthy replica's own "
                                 "p99, burn-rate alert span fires, "
                                 "perfwatch ingests fleet latency")
+            p.add_argument("--autoscale-probe", action="store_true",
+                           help="autoscaling drill (~3min scrubbed "
+                                "CPU): 1 replica + watch-discovery "
+                                "router + fleetmon + autopilot; a "
+                                "traffic burst overruns the replica -> "
+                                "autopilot spawns a second via "
+                                "supervise/discovery, admitted on "
+                                "merit within the advertised scale-up "
+                                "latency; calm traffic -> drains back "
+                                "to min and leases the freed capacity "
+                                "to a colocated trainer; perfwatch "
+                                "gates the scale-up-latency / SLO-"
+                                "violation / utilization series")
             p.add_argument("--reshape-drill", action="store_true",
                            help="elastic-capacity drill (~2min tiny CPU "
                                 "runs): mesh8 train preempted by an "
@@ -258,7 +286,8 @@ def main(argv=None):
                              sweep_probe=args.sweep_probe,
                              mem_probe=args.mem_probe,
                              partition_probe=args.partition_probe,
-                             reshape_drill=args.reshape_drill)
+                             reshape_drill=args.reshape_drill,
+                             autoscale_probe=args.autoscale_probe)
         return 0 if summary["ok"] else 1
 
     from tpu_resnet.config import load_config
@@ -346,6 +375,8 @@ def main(argv=None):
             result = request_drain(url, args.drain)
             print(json.dumps(result))
             return 0 if result.get("ok") else 1
+        if args.watch_discovery:
+            cfg.route.watch_discovery = True
         return route(cfg)
 
     if args.command == "fleetmon":
@@ -354,6 +385,15 @@ def main(argv=None):
         # must keep reporting while the data plane is on fire.
         from tpu_resnet.obs.fleet import fleetmon
         return fleetmon(cfg)
+
+    if args.command == "autopilot":
+        # The autoscaling control plane shares the host-isolation
+        # contract: it must keep steering the fleet while the
+        # accelerator stack is the thing that is melting, so no
+        # parallel.initialize() — only its CHILD serve processes may
+        # touch jax.
+        from tpu_resnet.autopilot.cli import autopilot as autopilot_fn
+        return autopilot_fn(cfg)
 
     if args.command == "inspect":
         from tpu_resnet.tools.inspect_ckpt import main as inspect_main

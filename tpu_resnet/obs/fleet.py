@@ -25,6 +25,12 @@ autoscaler will read:
   budget; burn rates over a fast and a slow window (the multiwindow SRE
   shape) gate a ``fleet_burn_alert`` span event — the fast window
   catches the spike, the slow window keeps a blip from paging.
+- **snapshot API**: every round also atomically replaces
+  ``<dir>/fleet_snapshot.json`` — the latest merged percentiles,
+  burn rates, and per-endpoint health (incl. HBM gauges) as ONE
+  digest-stamped file. ``read_fleet_snapshot`` is the consumer API the
+  autopilot (docs/AUTOPILOT.md) and ``obs_scrape --fleet`` share; the
+  append-only timeseries stays the historian.
 - **its own /metrics + /healthz**: the FLEET_GAUGES registry on
   ``fleet.port``, announced in ``<dir>/fleetmon.json``.
 
@@ -58,9 +64,15 @@ log = logging.getLogger("tpu_resnet")
 
 FLEET_DISCOVERY = "fleetmon.json"
 FLEET_TIMESERIES_FILE = "fleet_timeseries.jsonl"
+# Latest merged round as one atomically-replaced, digest-stamped file —
+# the consumer API for control loops (the autopilot) and obs_scrape
+# --fleet: read ONE file instead of re-parsing the timeseries stream.
+FLEET_SNAPSHOT_FILE = "fleet_snapshot.json"
 # Scraped series carry the exposition namespace — the key a /metrics
 # consumer must use, distinct from the bare declaration name.
 SERVE_LATENCY_SERIES = f"{NAMESPACE}_serve_latency_ms"
+HBM_IN_USE_SERIES = f"{NAMESPACE}_hbm_bytes_in_use"
+HBM_LIMIT_SERIES = f"{NAMESPACE}_hbm_bytes_limit"
 
 
 def discover_endpoints(directory: str) -> List[dict]:
@@ -229,15 +241,34 @@ class FleetAggregator:
                             SERVE_LATENCY_SERIES, {}), 0.99), 3),
                     "requests": int(r.get("histograms", {}).get(
                         SERVE_LATENCY_SERIES, {}).get("count", 0)),
+                    # Per-replica HBM, when the endpoint exports it —
+                    # the colocation headroom signal the autopilot
+                    # snapshot hands to its policy.
+                    **({"hbm_bytes_in_use":
+                        r["metrics"][HBM_IN_USE_SERIES],
+                        "hbm_bytes_limit":
+                        r["metrics"].get(HBM_LIMIT_SERIES, 0.0)}
+                       if HBM_IN_USE_SERIES in r.get("metrics", {})
+                       else {}),
                 }) for name, r in sorted(reports.items())},
         }
-        fast, slow, fired, cleared = self._note_round(now, merged)
+        fast, slow, fired, cleared, active, scrapes = \
+            self._note_round(now, merged)
         record["burn_rate_fast"] = round(fast, 3)
         record["burn_rate_slow"] = round(slow, 3)
         try:
             self._ts_f.write(json.dumps(record) + "\n")
         except ValueError:  # closed in a shutdown race
             pass
+        # Snapshot satellite of the timeseries line: same fields plus
+        # the round ordinal and alert state, replaced atomically and
+        # digest-stamped so a reader can never act on a torn or
+        # hand-edited file. Single writer (this scraper thread), I/O
+        # with no lock held.
+        write_fleet_snapshot(self.directory, {
+            **record, "round": scrapes, "alert_active": active,
+            "slo_ms": self.cfg.fleet.slo_ms,
+            "slo_target": self.cfg.fleet.slo_target})
         if fired:
             self.spans.event(
                 "fleet_burn_alert", burn_rate_fast=round(fast, 3),
@@ -260,7 +291,7 @@ class FleetAggregator:
     def _note_round(self, now: float, merged: dict):
         """Ring append + burn evaluation + alert transition, all under
         the lock (pure in-memory — the I/O stays outside). Returns
-        ``(burn_fast, burn_slow, fired, cleared)``."""
+        ``(burn_fast, burn_slow, fired, cleared, active, scrapes)``."""
         cfg = self.cfg.fleet
         with self._lock:
             self._scrapes += 1
@@ -285,7 +316,8 @@ class FleetAggregator:
             self._alert_active = hot
             if fired:
                 self._alerts += 1
-        return fast, slow, fired, cleared
+            scrapes = self._scrapes
+        return fast, slow, fired, cleared, hot, scrapes
 
     def _window_base(self, now: float, window_secs: float) -> dict:
         """Oldest ring round inside the window (lock held by caller).
@@ -366,6 +398,51 @@ class FleetAggregator:
         except OSError:  # pragma: no cover - fs-specific
             pass
         self.spans.close()
+
+
+def write_fleet_snapshot(directory: str, payload: dict) -> None:
+    """Atomic ``<dir>/fleet_snapshot.json``: the payload plus a sha256
+    ``digest`` over its canonical JSON. tmp + ``os.replace`` means a
+    reader sees the previous complete snapshot or this one, never a
+    torn write — and the digest catches everything replace can't
+    (a partial copy, a hand edit)."""
+    import hashlib
+
+    body = dict(payload)
+    body.pop("digest", None)
+    canon = json.dumps(body, sort_keys=True)
+    body["digest"] = hashlib.sha256(canon.encode()).hexdigest()
+    path = os.path.join(directory, FLEET_SNAPSHOT_FILE)
+    tmp = path + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(body, f, indent=2)
+        os.replace(tmp, path)
+    except OSError as e:  # the sensor must outlive a full disk
+        log.warning("fleetmon: snapshot write failed: %s", e)
+
+
+def read_fleet_snapshot(directory: str) -> Optional[dict]:
+    """Digest-verified read of the latest fleet snapshot. None when the
+    file is absent, unparseable, or fails its digest — a control loop
+    (the autopilot) treats all three the same: no trustworthy fleet
+    signal this round."""
+    import hashlib
+
+    path = os.path.join(directory, FLEET_SNAPSHOT_FILE)
+    try:
+        with open(path) as f:
+            body = json.load(f)
+        digest = body.pop("digest")
+    except (OSError, ValueError, KeyError):
+        return None
+    canon = json.dumps(body, sort_keys=True)
+    if hashlib.sha256(canon.encode()).hexdigest() != digest:
+        log.warning("fleetmon: snapshot digest mismatch — ignoring %s",
+                    path)
+        return None
+    body["digest"] = digest
+    return body
 
 
 def write_fleet_discovery(directory: str, port: int,

@@ -31,6 +31,10 @@ Lanes (Chrome trace "processes"/"threads"):
   events, laid beside the replica lanes they caused.
 - **fleetmon** (``fleet_events.jsonl``): the fleet telemetry aggregator
   (obs/fleet.py) — scrape rounds and SLO burn-rate alert events.
+- **autopilot** (``autopilot_events.jsonl``): the autoscaling control
+  plane (tpu_resnet/autopilot/) — every policy decision, spawn/drain
+  actuation, admission denial, and capacity-lease handoff, laid beside
+  the router/replica lanes it steered.
 - **requests** (synthetic process): per-request distributed-trace lanes
   — one thread per tail-sampled trace id, holding the router's
   ``route_request`` span (per-leg attribution in args) with the
@@ -79,14 +83,15 @@ from tpu_resnet.obs.spans import load_jsonl, load_spans
 SERVE_EVENTS_FILE = "serve_events.jsonl"
 ROUTE_EVENTS_FILE = "route_events.jsonl"
 FLEET_EVENTS_FILE = "fleet_events.jsonl"
+AUTOPILOT_EVENTS_FILE = "autopilot_events.jsonl"
 TRACE_FILE = "trace.json"
 
 # Synthetic lane ids used when a source file predates pid stamping.
 _FALLBACK_PID = {"train": 1, "eval": 2, "serve": 3, "route": 4,
-                 "fleet": 5}
+                 "fleet": 5, "autopilot": 6}
 # Thread ids within a lane (Chrome traces key threads by (pid, tid)).
 _TID_SPANS = {"train": 1, "eval": 11, "serve": 21, "route": 31,
-              "fleet": 41}
+              "fleet": 41, "autopilot": 51}
 _TID_BREAKDOWN = 2
 _TID_ENGINE = 3
 # Dedicated transfer lane: h2d_transfer spans (the double-buffered
@@ -462,6 +467,8 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
         "serve": load_spans(os.path.join(train_dir, SERVE_EVENTS_FILE)),
         "route": load_spans(os.path.join(train_dir, ROUTE_EVENTS_FILE)),
         "fleet": load_spans(os.path.join(train_dir, FLEET_EVENTS_FILE)),
+        "autopilot": load_spans(os.path.join(train_dir,
+                                             AUTOPILOT_EVENTS_FILE)),
     }
     metrics = load_jsonl(os.path.join(train_dir, "metrics.jsonl"), "step")
 
@@ -501,7 +508,8 @@ def build_trace(train_dir: str, device_trace: bool = False) -> dict:
         (ids[0] for ids in source_run_ids.values() if ids), None)
 
     labels = {"train": "trainer", "eval": "eval-sidecar",
-              "serve": "serve", "route": "router", "fleet": "fleetmon"}
+              "serve": "serve", "route": "router", "fleet": "fleetmon",
+              "autopilot": "autopilot"}
     for src, spans in sources.items():
         if not spans and not (src == "train" and metrics):
             continue

@@ -253,6 +253,12 @@ class Conductor:
             return read_route_port(directory)
         if source == "telemetry":
             return read_telemetry_port(directory)
+        if source == "fleetmon":
+            from tpu_resnet.obs.fleet import FLEET_DISCOVERY
+            return read_port(directory, FLEET_DISCOVERY)
+        if source == "autopilot":
+            from tpu_resnet.autopilot.controller import AUTOPILOT_DISCOVERY
+            return read_port(directory, AUTOPILOT_DISCOVERY)
         name = step.get("name")
         return read_port(directory,
                          f"serve-{name}.json" if name else "serve.json")

@@ -21,7 +21,8 @@ from typing import Optional
 
 # Process kinds the conductor knows how to spawn (conductor._build_argv).
 PROC_KINDS = ("train", "train_and_eval", "eval", "serve", "route",
-              "fleetmon", "loadgen", "supervise", "sweep", "cmd")
+              "fleetmon", "autopilot", "loadgen", "supervise", "sweep",
+              "cmd")
 
 # The faultinject env contract: TPU_RESNET_FAULT_<key> (faultinject.py
 # FaultPlan.from_config). Validated here so a typo'd fault silently

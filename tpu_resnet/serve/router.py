@@ -142,7 +142,7 @@ class Replica:
     def __init__(self, name: str, url: str, pid: Optional[int] = None,
                  run_id: Optional[str] = None,
                  fail_threshold: int = 2, open_secs: float = 5.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, pending: bool = False):
         self.name = name
         self.url = url.rstrip("/")
         self.pid = pid
@@ -150,6 +150,9 @@ class Replica:
         self.breaker = CircuitBreaker(fail_threshold, open_secs,
                                       clock=clock)
         self.draining = False       # admin drain: excluded, not failed
+        self.pending = pending      # probation: out of rotation until the
+        #                             first successful probe admits it
+        #                             (route.watch_discovery)
         self.queue_depth = 0        # passive signal from the /info probe
         self.model_step = -1
         self.image_shape: Optional[list] = None
@@ -168,11 +171,13 @@ class Replica:
 
     @property
     def healthy(self) -> bool:
-        return self.breaker.closed and not self.draining
+        return self.breaker.closed and not self.draining \
+            and not self.pending
 
     def describe(self) -> dict:
         return {"name": self.name, "url": self.url, "pid": self.pid,
                 "state": self.breaker.state, "draining": self.draining,
+                "pending": self.pending,
                 "inflight": self.inflight,
                 "queue_depth": self.queue_depth,
                 "model_step": self.model_step,
@@ -231,6 +236,11 @@ class Router:
         self._p_cache = (0.0, 0.0, 0.0)     # (asof, p50, p99)
         self._accepting = True
         self._stop = threading.Event()
+        self._booted = False  # watch-discovery: boot-time replicas are
+        #                       admitted as before; only post-boot
+        #                       arrivals serve the probation
+
+
 
         self.registry = registry if registry is not None else \
             TelemetryRegistry(gauges=ROUTE_GAUGES,
@@ -249,6 +259,7 @@ class Router:
         for i, url in enumerate(cfg.route.replicas):
             self._upsert_replica(f"r{i}", str(url), pid=None, run_id=None)
         self.refresh_discovery()
+        self._booted = True
 
         self._httpd = ThreadingHTTPServer((cfg.route.host, cfg.route.port),
                                           self._make_handler())
@@ -273,10 +284,17 @@ class Router:
         if cur is not None and cur.url == url.rstrip("/") \
                 and cur.pid == pid:
             return
+        # Merit gating (route.watch_discovery): anything that appears or
+        # re-resolves AFTER boot starts in probation — out of rotation
+        # until its first successful health probe clears `pending`. The
+        # default stays the historical blind admission (fresh closed
+        # breaker = instantly routable) so static fleets keep their
+        # zero-probe fast path.
+        pending = bool(self.cfg.route.watch_discovery and self._booted)
         replica = Replica(name, url, pid=pid, run_id=run_id,
                           fail_threshold=self.cfg.route.fail_threshold,
                           open_secs=self.cfg.route.open_secs,
-                          clock=self._clock)
+                          clock=self._clock, pending=pending)
         self._replicas[name] = replica
         if cur is not None:
             log.info("route: replica %s re-resolved %s -> %s", name,
@@ -381,6 +399,15 @@ class Router:
                     # Came back after a drain-kill cycle (rolling
                     # upgrade): clear the admin exclusion on readmit.
                     r.draining = False
+                if r.pending:
+                    # First successful probe of a watch-discovery
+                    # arrival: probation over, admitted on merit.
+                    r.pending = False
+                    log.info("route: replica %s admitted on merit "
+                             "(watch-discovery probation cleared)",
+                             r.name)
+                    self.spans.event("replica_admitted", replica=r.name,
+                                     url=r.url)
                 r.breaker.record_success()
             else:
                 r.breaker.record_failure()
@@ -885,7 +912,11 @@ class Router:
             return
         self._closed = True
         self._stop.set()
-        self._httpd.shutdown()
+        # shutdown() handshakes with serve_forever and blocks forever if
+        # the HTTP thread never ran (a Router driven synchronously via
+        # refresh_discovery()/probe_once() without start()).
+        if self._http_thread.is_alive():
+            self._httpd.shutdown()
         self._httpd.server_close()
         self.spans.close()
 

@@ -110,6 +110,18 @@ Checks:
              topology_change span must land on the run timeline, and
              perfwatch must ingest the pre/post steps/s (post
              normalized by the device ratio) as a tracked series
+  autoscale_probe  optional (--autoscale-probe): autopilot control-loop
+             drill (tpu_resnet/autopilot) — the checked-in
+             ``scenarios/autoscale_burst.json`` end to end: a burst
+             against one slow replica must make the autopilot spawn a
+             second through supervise + watch-discovery probation
+             (within the advertised scale-up-latency budget), the calm
+             phase must drain it back via the router's rolling
+             contract with zero hard client failures, the freed
+             capacity must land in ``capacity_lease.json`` for the
+             colocated trainer, and perfwatch must ingest the
+             scale-up-latency / SLO-violation-seconds /
+             replica-seconds series (docs/AUTOPILOT.md)
   fault_drill  optional (--fault-drill): a live SIGTERM+resume drill
              against a temp train_dir — a tiny CPU run is preempted by an
              injected SIGTERM, must exit with the preemption code with a
@@ -1484,6 +1496,35 @@ def _check_reshape_drill(timeout: int = 480) -> dict:
     return out
 
 
+def _check_autoscale_probe(timeout: int = 900) -> dict:
+    """Autopilot autoscaling drill in scrubbed CPU subprocesses.
+
+    Thin alias over ``scenarios/autoscale_burst.json`` — the scenario
+    conductor runs the whole loop (burst → spawn → admit → calm →
+    drain → capacity handoff); this adapter rebuilds the historical
+    DOCTOR_JSON dict from its observations."""
+    result, steps = _run_scenario("autoscale_burst")
+    if not result["ok"]:
+        return _scenario_fail(result)
+    out = {"scale_up_latency_ms":
+               steps["scaleup"]["observed"]["scale_up_latency_ms"],
+           "scale_ups": int(steps["scaleup"]["observed"]["scale_ups"]),
+           "scale_downs":
+               int(steps["rampdown"]["observed"]["scale_downs"]),
+           "capacity_lease":
+               steps["capacity_lease"]["observed"].get("state",
+                                                       "granted"),
+           "burst_failed":
+               steps["burst_verdict"]["observed"]["failed"],
+           "calm_failed":
+               steps["calm_verdict"]["observed"]["failed"],
+           "colocated_trainer_rc": result["rcs"]["trainer"]}
+    if _scenario_perfwatch(result, out):
+        return out
+    out["ok"] = True
+    return out
+
+
 def _check_fault_drill(timeout: int = 240) -> dict:
     """SIGTERM + resume drill in scrubbed CPU subprocesses (~30 s on a
     healthy box: tiny MLP, 40 steps). Stdlib-only checks: exit codes, the
@@ -1508,6 +1549,7 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
                check_matrix: bool = True, serve_probe: bool = False,
                coldstart_probe: bool = False,
                fleet_probe: bool = False, fleetmon_probe: bool = False,
+               autoscale_probe: bool = False,
                trace_probe: bool = False, perfwatch: bool = False,
                sweep_probe: bool = False, mem_probe: bool = False,
                partition_probe: bool = False, reshape_drill: bool = False,
@@ -1556,6 +1598,9 @@ def run_doctor(dataset: str = "", data_dir: str = "", train_dir: str = "",
     if fleetmon_probe:
         summary["fleetmon_probe"] = _check_fleetmon_probe()
         emit("fleetmon_probe", summary["fleetmon_probe"])
+    if autoscale_probe:
+        summary["autoscale_probe"] = _check_autoscale_probe()
+        emit("autoscale_probe", summary["autoscale_probe"])
     if trace_probe:
         summary["trace_probe"] = _check_trace_probe()
         emit("trace_probe", summary["trace_probe"])

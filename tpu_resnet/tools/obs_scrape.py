@@ -81,7 +81,8 @@ def scrape_fleet(directory: str, timeout: float = 5.0) -> dict:
     ``{"error": ...}`` rows, not exceptions — a half-up fleet is
     exactly when you run this."""
     from tpu_resnet.obs.fleet import (SERVE_LATENCY_SERIES,
-                                      discover_endpoints)
+                                      discover_endpoints,
+                                      read_fleet_snapshot)
     from tpu_resnet.obs.server import merge_histograms
 
     endpoints = discover_endpoints(directory)
@@ -101,7 +102,12 @@ def scrape_fleet(directory: str, timeout: float = 5.0) -> dict:
     except ValueError as e:
         merged = {"buckets": [], "sum": 0.0, "count": 0,
                   "merge_error": str(e)}
-    return {"directory": directory, "endpoints": rows, "fleet": merged}
+    # The same digest-verified file the autopilot consumes: fleetmon's
+    # latest merged round, or None when fleetmon isn't running (or the
+    # file failed its digest) — the live scrape above stands alone.
+    snapshot = read_fleet_snapshot(directory)
+    return {"directory": directory, "endpoints": rows, "fleet": merged,
+            "snapshot": snapshot}
 
 
 def format_fleet_report(report: dict, as_json: bool = False) -> str:
@@ -141,6 +147,15 @@ def format_fleet_report(report: dict, as_json: bool = False) -> str:
             "fleet", "(histogram merge)", "-",
             str(merged.get("count", 0)), f"{qs[0.50]:g}",
             f"{qs[0.95]:g}", f"{qs[0.99]:g}", ""))
+    snap = report.get("snapshot")
+    if snap:
+        lines.append(
+            f"  fleetmon snapshot: round {snap.get('round')} "
+            f"p99={snap.get('fleet', {}).get('p99_ms', 0):g}ms "
+            f"burn fast/slow="
+            f"{snap.get('burn_rate_fast', 0):g}/"
+            f"{snap.get('burn_rate_slow', 0):g} "
+            f"(digest ok)")
     return "\n".join(lines)
 
 
